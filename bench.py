@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Benchmark: steady-state training throughput, printed as ONE JSON line.
+
+Metric: images/sec/chip on the LeNet-5 data-parallel workload
+[BASELINE.json metric: "MNIST images/sec/chip"; config 4: global batch 512].
+The full fused step (fwd+bwd+allreduce+update, on-device batch gather) is
+timed after a compile/warmup phase, on every visible device of the default
+backend (the real TPU chip under the driver).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md — empty mount,
+published={}); the only quantitative anchor is the driver's north-star
+target "≥99% in <30s on a v4-8 with near-linear scaling", which implies
+roughly 10 epochs * 60k images / 30s / 8 chips = 2500 images/sec/chip.
+vs_baseline is value / 2500 — i.e. >1.0 means faster than the target rate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+TARGET_IPS_PER_CHIP = 2500.0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--global-batch", type=int, default=512)
+    p.add_argument("--warmup-steps", type=int, default=20)
+    p.add_argument("--bench-steps", type=int, default=200,
+                   help="must be >= 1")
+    p.add_argument("--model", default="lenet")
+    p.add_argument("--dtype", default="float32")
+    args = p.parse_args(argv)
+    if args.bench_steps < 1:
+        p.error("--bench-steps must be >= 1")
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import models, optim
+    from distributedmnist_tpu.data import load_mnist
+    from distributedmnist_tpu.data.loader import DeviceDataset, IndexStream
+    from distributedmnist_tpu.parallel import make_mesh, replicated
+    from distributedmnist_tpu.trainer import init_state, make_train_step
+
+    from distributedmnist_tpu.utils import round_up
+
+    devs = jax.devices()
+    n_chips = len(devs)
+    gb = round_up(args.global_batch, n_chips)
+    mesh = make_mesh(devs)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    data = load_mnist(synthetic=True, seed=0)  # pixels identical cost to real
+    ds = DeviceDataset(data, mesh)
+    model = models.build(args.model, dtype=dtype,
+                         platform=devs[0].platform)
+    tx = optim.build("adam", 1e-3)
+    state = jax.device_put(
+        init_state(jax.random.PRNGKey(0), model, tx,
+                   jnp.zeros((1, 28, 28, 1))),
+        replicated(mesh))
+    step_fn = make_train_step(model, tx, mesh, mode="auto", dtype=dtype)
+    stream = IndexStream(ds.train_n, gb, seed=0, mesh=mesh)
+
+    # CPU's collective rendezvous deadlocks under concurrent in-flight
+    # programs (small host thread pool); TPU pipelines safely.
+    sync_every_step = devs[0].platform == "cpu"
+
+    def run(n):
+        metrics = None
+        for _ in range(n):
+            state_box[0], metrics = step_fn(state_box[0], ds.train_x,
+                                            ds.train_y, next(stream))
+            if sync_every_step:
+                jax.block_until_ready(metrics["loss"])
+        if metrics is not None:
+            jax.block_until_ready(metrics["loss"])
+
+    state_box = [state]
+    run(args.warmup_steps)
+    t0 = time.perf_counter()
+    run(args.bench_steps)
+    elapsed = time.perf_counter() - t0
+
+    ips = args.bench_steps * gb / elapsed
+    value = ips / n_chips
+    print(json.dumps({
+        "metric": "train_images_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / TARGET_IPS_PER_CHIP, 3),
+        "detail": {
+            "model": args.model,
+            "global_batch": gb,
+            "n_chips": n_chips,
+            "backend": devs[0].platform,
+            "dtype": args.dtype,
+            "bench_steps": args.bench_steps,
+            "step_ms": round(1000 * elapsed / args.bench_steps, 3),
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""distributedmnist_tpu — a TPU-native (JAX/XLA) re-design of the capabilities
+of the reference repo `stsievert/DistributedMNIST`.
+
+The reference (per /root/repo/BASELINE.json — the reference mount is empty, so
+all parity claims cite BASELINE.json fields rather than file:line; see
+SURVEY.md §0) is an NCCL-based data-parallel MNIST trainer:
+
+- two models: 2-layer MLP (784-128-10) and LeNet-5  [BASELINE.json configs 1-2]
+- two optimizers: SGD and Adam                       [configs 1-2]
+- data parallelism via per-step NCCL gradient allreduce [north_star, configs 3-4]
+- shard-by-rank DataLoader                           [north_star]
+- async checkpoint/restore                           [config 5]
+- metric: MNIST images/sec/chip; wall-clock to 99% test accuracy [metric]
+
+This package is NOT a port. The TPU-native design:
+
+- the forward/backward/allreduce/update is ONE fused XLA program under
+  `jax.jit` — the gradient reduction is a `lax.psum`/XLA collective over a
+  named ICI mesh axis *inside* the compiled step, not a separate
+  post-backward NCCL call;
+- the dataset lives device-resident (uint8) and batches are gathered on
+  device by a jitted index lookup, so the input pipeline can never starve a
+  ~100µs TPU step;
+- multi-host scale uses `jax.distributed.initialize` + per-process batch
+  assembly (`jax.make_array_from_process_local_data`) — collectives ride
+  ICI within a host and DCN across hosts, both inserted by XLA.
+"""
+
+__version__ = "0.1.0"
+
+from distributedmnist_tpu.config import Config, PRESETS  # noqa: F401

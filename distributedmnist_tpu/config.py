@@ -1,0 +1,132 @@
+"""Configuration: frozen dataclass + the five named presets.
+
+The five presets are exactly the five workloads the reference must support
+per BASELINE.json `configs` (reference mount is empty; BASELINE.json is the
+authoritative capability spec — SURVEY.md §0):
+
+1. single-process 2-layer MLP (784-128-10) on MNIST, SGD, batch=64
+2. single-process LeNet-5 CNN on MNIST, Adam
+3. 2-worker data-parallel MLP with gradient allreduce
+4. 8-chip data-parallel LeNet-5, per-rank sharding, global batch=512
+5. multi-host v4-32 data-parallel LeNet-5 with async checkpoint/restore
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # model / optimizer
+    model: str = "lenet"            # {mlp, lenet}
+    optimizer: str = "adam"         # {sgd, adam}
+    learning_rate: float = 1e-3
+    momentum: float = 0.9           # used by sgd only
+    # data
+    data_dir: Optional[str] = None  # dir with IDX (*-ubyte[.gz]) or mnist.npz
+    synthetic: bool = False         # force deterministic synthetic MNIST
+    batch_size: int = 512           # GLOBAL batch size (split across chips)
+    # schedule
+    epochs: int = 10
+    steps: Optional[int] = None     # overrides epochs when set
+    eval_every: int = 200           # steps between test-set evals
+    target_accuracy: Optional[float] = 0.99  # early-stop when reached
+    seed: int = 0
+    # device / parallelism
+    device: str = "auto"            # {auto, tpu, cpu}
+    num_devices: Optional[int] = None  # None = all visible devices
+    spmd_mode: str = "auto"         # {auto: jit+shardings, explicit: shard_map+psum}
+    dtype: str = "float32"          # compute dtype {float32, bfloat16}
+    # checkpointing
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 500     # steps between async saves
+    resume: bool = True             # restore latest checkpoint if present
+    # multi-host (config 5)
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    # test/fault-injection hook (SURVEY.md §5 failure detection)
+    fail_at_step: Optional[int] = None
+    # dispatch pipelining: max steps in flight before blocking on the
+    # oldest result. None = auto (deep on TPU to keep the pipeline full;
+    # 1 on CPU, whose collective rendezvous deadlocks under concurrent
+    # programs when the host thread pool is small).
+    max_inflight: Optional[int] = None
+    # observability
+    profile_dir: Optional[str] = None  # jax.profiler trace output
+    log_every: int = 100
+    # ops
+    fused_kernels: str = "auto"     # {auto, pallas, xla}: pallas fused MLP layer
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+# BASELINE.json configs[0..4] as named presets.
+PRESETS: dict[str, Config] = {
+    # config 1: single-process 2-layer MLP (784-128-10) on MNIST, SGD, batch=64
+    "mlp-sgd": Config(model="mlp", optimizer="sgd", learning_rate=0.1,
+                      batch_size=64, num_devices=1),
+    # config 2: single-process LeNet-5 CNN on MNIST, Adam
+    "lenet-adam": Config(model="lenet", optimizer="adam", learning_rate=1e-3,
+                         num_devices=1, batch_size=128),
+    # config 3: 2-worker data-parallel MLP with gradient allreduce
+    "mlp-dp2": Config(model="mlp", optimizer="sgd", learning_rate=0.1,
+                      batch_size=128, num_devices=2),
+    # config 4: 8-chip data-parallel LeNet-5, per-rank sharding, batch=512
+    "lenet-dp8": Config(model="lenet", optimizer="adam", learning_rate=1e-3,
+                        batch_size=512, num_devices=8),
+    # config 5: multi-host data-parallel LeNet-5 with async checkpoint/restore
+    # (coordinator/num_processes/process_id supplied on the command line)
+    "lenet-multihost": Config(model="lenet", optimizer="adam",
+                              learning_rate=1e-3, batch_size=512,
+                              checkpoint_dir="checkpoints",
+                              checkpoint_every=200),
+}
+
+
+def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    p.add_argument("--preset", choices=sorted(PRESETS), default=None,
+                   help="named workload preset (BASELINE.json configs 1-5)")
+    p.add_argument("--model", choices=["mlp", "lenet"], default=None)
+    p.add_argument("--optimizer", choices=["sgd", "adam"], default=None)
+    p.add_argument("--learning-rate", type=float, default=None)
+    p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--synthetic", action="store_true", default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--eval-every", type=int, default=None)
+    p.add_argument("--target-accuracy", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--device", choices=["auto", "tpu", "cpu"], default=None)
+    p.add_argument("--num-devices", type=int, default=None)
+    p.add_argument("--spmd-mode", choices=["auto", "explicit"], default=None)
+    p.add_argument("--dtype", choices=["float32", "bfloat16"], default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--no-resume", dest="resume", action="store_false",
+                   default=None)
+    p.add_argument("--coordinator-address", default=None)
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--fail-at-step", type=int, default=None)
+    p.add_argument("--profile-dir", default=None)
+    p.add_argument("--log-every", type=int, default=None)
+    p.add_argument("--fused-kernels", choices=["auto", "pallas", "xla"],
+                   default=None)
+    return p
+
+
+def from_args(args: argparse.Namespace) -> Config:
+    cfg = PRESETS[args.preset] if args.preset else Config()
+    overrides = {}
+    for f in dataclasses.fields(Config):
+        v = getattr(args, f.name, None)
+        if v is not None:
+            overrides[f.name] = v
+    return cfg.replace(**overrides)

@@ -1,0 +1,5 @@
+from distributedmnist_tpu.data.mnist import load_mnist, synthetic_mnist  # noqa: F401
+from distributedmnist_tpu.data.loader import (  # noqa: F401
+    DeviceDataset,
+    IndexStream,
+)

@@ -1,0 +1,103 @@
+"""Device-resident dataset + sharded per-step index streams.
+
+TPU-native inversion of the reference's "shard-by-rank DataLoader"
+[BASELINE.json north_star]: instead of each rank's host process reading and
+batching its slice of MNIST, the entire (tiny) dataset is pushed to device
+HBM once as uint8 (~47 MB for train), and each step a *global-batch index
+array* — sharded over the 'data' mesh axis — selects rows with an on-device
+gather inside the jitted step. Normalization (cast + /255) happens in-step so
+XLA fuses it with the first matmul/conv and the host never touches pixels in
+the hot loop. A TPU MNIST step is ~100µs; any per-step host work would
+dominate (SURVEY.md §7.3), which is why batches are *indices*, not arrays.
+
+Determinism: batch order is a function of (seed, epoch) only — independent of
+device count — which is what makes the seed-for-seed 1-chip ≡ N-chip
+equivalence test possible (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class DeviceDataset:
+    """Train/test arrays placed on devices, replicated over the mesh.
+
+    Replication (not sharding) of the dataset is deliberate: a per-step
+    gather of arbitrary global indices from a row-sharded array would need an
+    all-to-all; from a replicated array it is a local gather, and only the
+    tiny index array is sharded. For MNIST-scale data (<50 MB uint8) HBM
+    replication is free; the batch that results from the gather IS sharded
+    over 'data' because the indices are.
+    """
+
+    def __init__(self, data: dict, mesh: Mesh):
+        self.mesh = mesh
+        self.source = data.get("source", "unknown")
+        rep = NamedSharding(mesh, P())  # replicated over every mesh axis
+        self.train_x = jax.device_put(data["train_x"], rep)
+        self.train_y = jax.device_put(data["train_y"], rep)
+        self.test_x = jax.device_put(data["test_x"], rep)
+        self.test_y = jax.device_put(data["test_y"], rep)
+        self.train_n = int(data["train_x"].shape[0])
+        self.test_n = int(data["test_x"].shape[0])
+
+
+class IndexStream:
+    """Seeded stream of global-batch index arrays, sharded over 'data'.
+
+    Epoch semantics match a classic shuffling DataLoader with
+    drop_last=True: each epoch is a fresh seeded permutation of the train
+    set, cut into global batches. The permutation depends only on
+    (seed, epoch), never on device or process count.
+
+    Multi-host: every process computes the same permutation (same seed) and
+    could slice out only its addressable portion; single-host simply
+    device_puts the full index array with the sharded layout. The
+    `process_slice` hook is the seam config-5 (multi-host) uses.
+    """
+
+    def __init__(self, train_n: int, global_batch: int, seed: int,
+                 mesh: Mesh, start_step: int = 0):
+        if global_batch > train_n:
+            raise ValueError(f"global batch {global_batch} > dataset {train_n}")
+        self.train_n = train_n
+        self.global_batch = global_batch
+        self.seed = seed
+        self.mesh = mesh
+        self.sharding = NamedSharding(mesh, P("data"))
+        self.steps_per_epoch = train_n // global_batch
+        self.step = start_step
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        return np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch])).permutation(self.train_n)
+
+    def indices_for_step(self, step: int) -> np.ndarray:
+        epoch, k = divmod(step, self.steps_per_epoch)
+        perm = self._epoch_perm(epoch)
+        return perm[k * self.global_batch:(k + 1) * self.global_batch]
+
+    def __iter__(self) -> Iterator[jax.Array]:
+        return self
+
+    def __next__(self) -> jax.Array:
+        from distributedmnist_tpu.parallel import distributed
+        idx = self.indices_for_step(self.step).astype(np.int32)
+        self.step += 1
+        return distributed.global_batch_indices(idx, self.mesh)
+
+
+def eval_batches(test_n: int, batch: int) -> tuple[np.ndarray, np.ndarray]:
+    """Index matrix (n_batches, batch) covering the test set plus a bool
+    mask of the same shape; tail padding (index 0 repeated) is masked False
+    so it never enters the accuracy numerator."""
+    n_batches = (test_n + batch - 1) // batch
+    pos = np.arange(n_batches * batch).reshape(n_batches, batch)
+    mask = pos < test_n
+    idx = np.where(mask, pos, 0).astype(np.int32)
+    return idx, mask

@@ -1,0 +1,22 @@
+from distributedmnist_tpu.models.mlp import MLP  # noqa: F401
+from distributedmnist_tpu.models.lenet import LeNet5  # noqa: F401
+
+
+def build(name: str, dtype=None, fused: str = "auto",
+          platform: str | None = None):
+    """Model factory for the two reference architectures
+    [BASELINE.json configs: "2-layer MLP (784-128-10)", "LeNet-5 CNN"].
+
+    `platform` is the platform of the devices the model will RUN on (the
+    mesh's platform, not jax.default_backend()) — it resolves the 'auto'
+    fused-kernel mode; None falls back to the default backend.
+    """
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.ops import fused as fused_lib
+    dtype = dtype or jnp.float32
+    if name == "mlp":
+        return MLP(dtype=dtype, fused=fused_lib.resolve(fused, platform))
+    if name == "lenet":
+        return LeNet5(dtype=dtype)
+    raise ValueError(f"unknown model {name!r} (expected mlp|lenet)")

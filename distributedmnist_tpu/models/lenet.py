@@ -1,0 +1,38 @@
+"""LeNet-5 CNN — BASELINE.json configs 2/4/5's model.
+
+Classic LeCun-98 LeNet-5 adapted to 28x28 MNIST input (the original takes
+32x32, so conv1 uses SAME padding): conv 5x5x6 -> avgpool 2 -> conv 5x5x16
+(VALID) -> avgpool 2 -> flatten(400) -> Dense(120) -> Dense(84) -> Dense(10).
+61,706 parameters (pinned by test). relu instead of tanh — the standard
+modern variant, and what gets MNIST past 99% (SURVEY.md §7.3 notes LeNet-5
+is the model the wall-clock-to-99% harness must default to).
+
+TPU notes: convs lower straight to the MXU via XLA conv ops — no custom
+kernels needed (SURVEY.md §2 row 3). NHWC layout throughout (TPU-native).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)                       # (B, 28, 28, 1)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype,
+                    name="conv1")(x)                   # (B, 28, 28, 6)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))     # (B, 14, 14, 6)
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype,
+                    name="conv2")(x)                   # (B, 10, 10, 16)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))     # (B, 5, 5, 16)
+        x = x.reshape((x.shape[0], -1))                # (B, 400)
+        x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype, name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)

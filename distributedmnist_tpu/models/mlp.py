@@ -1,0 +1,41 @@
+"""2-layer MLP, 784-128-10 — BASELINE.json config 1's model.
+
+Exact architecture from the spec string "2-layer MLP (784-128-10)":
+flatten -> Dense(128) -> relu -> Dense(10). Parameter count is pinned by a
+unit test to 784*128+128 + 128*10+10 = 101,770 (SURVEY.md §2 row 2).
+
+The hidden layer can route through the fused Pallas dense+relu kernel
+(ops/fused.py) — one MXU pass with the bias-add and relu fused in the
+kernel epilogue instead of separate HBM round-trips. XLA usually fuses
+these anyway; the Pallas path exists to pin the fusion and as the
+framework's exemplar custom kernel. `fused="auto"` uses Pallas on TPU only.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributedmnist_tpu.ops import fused
+
+
+class MLP(nn.Module):
+    hidden: int = 128
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    fused: str = fused.XLA  # a RESOLVED mode (ops.fused.resolve output)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)  # (B, 784)
+        if self.fused in (fused.PALLAS, fused.PALLAS_INTERPRET):
+            w = self.param("hidden_kernel", nn.initializers.lecun_normal(),
+                           (x.shape[-1], self.hidden), self.dtype)
+            b = self.param("hidden_bias", nn.initializers.zeros,
+                           (self.hidden,), self.dtype)
+            x = fused.dense_relu(x, w, b,
+                                 self.fused == fused.PALLAS_INTERPRET)
+        else:
+            x = nn.Dense(self.hidden, dtype=self.dtype, name="hidden")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)

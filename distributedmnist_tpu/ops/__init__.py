@@ -1,0 +1,5 @@
+from distributedmnist_tpu.ops.loss import (  # noqa: F401
+    cross_entropy,
+    accuracy_count,
+)
+from distributedmnist_tpu.ops import fused  # noqa: F401

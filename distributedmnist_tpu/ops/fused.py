@@ -1,0 +1,115 @@
+"""Pallas fused dense+bias+relu — the framework's exemplar custom TPU kernel.
+
+One MXU matmul with the bias-add and relu fused into the kernel epilogue, so
+the activation never round-trips HBM between the matmul and the nonlinearity.
+XLA's fusion usually achieves the same; this kernel pins it deterministically
+and demonstrates the Pallas path the framework uses for hot ops
+(/opt/skills/guides/pallas_guide.md playbook: block over M x N, keep the
+reduction dim whole in VMEM, accumulate in f32 via preferred_element_type).
+
+The kernel is forward-only; training routes gradients through a custom VJP
+whose backward is plain XLA (dx = g@W.T etc.) — the standard split for
+epilogue-fused kernels.
+
+Mode resolution happens against the platform of the mesh the step actually
+runs on (NOT jax.default_backend(), which may differ under --device=cpu on
+a TPU host): `resolve(mode, platform)` returns the concrete kernel choice,
+and on non-TPU platforms the Pallas path runs in interpret mode so the same
+code is exercised by CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Concrete kernel modes after resolution.
+PALLAS = "pallas"            # compiled Pallas kernel (TPU)
+PALLAS_INTERPRET = "pallas-interpret"   # Pallas in interpret mode (tests)
+XLA = "xla"                  # plain jnp; XLA fuses
+
+
+def resolve(mode: str, platform: str | None = None) -> str:
+    """Map a user-facing mode {auto, pallas, xla} to a concrete kernel
+    choice for the platform the computation will run on."""
+    platform = platform or jax.default_backend()
+    if mode == "xla":
+        return XLA
+    if mode == "pallas":
+        return PALLAS if platform == "tpu" else PALLAS_INTERPRET
+    if mode == "auto":
+        return PALLAS if platform == "tpu" else XLA
+    if mode in (PALLAS, PALLAS_INTERPRET):
+        return mode
+    raise ValueError(f"unknown fused-kernel mode {mode!r}")
+
+
+def _dense_relu_kernel(x_ref, w_ref, b_ref, o_ref):
+    acc = jnp.dot(x_ref[...], w_ref[...],
+                  preferred_element_type=jnp.float32)
+    acc = acc + b_ref[...].astype(jnp.float32)
+    o_ref[...] = jnp.maximum(acc, 0.0).astype(o_ref.dtype)
+
+
+def _dense_relu_fwd_pallas(x: jax.Array, w: jax.Array, b: jax.Array,
+                           interpret: bool) -> jax.Array:
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    bm = 128 if m >= 128 else m          # MXU-friendly row tile
+    bn = 128 if n >= 128 else n
+    pad_m = (-m) % bm
+    pad_n = (-n) % bn
+    if pad_m:
+        x = jnp.pad(x, ((0, pad_m), (0, 0)))
+    if pad_n:
+        w = jnp.pad(w, ((0, 0), (0, pad_n)))
+        b = jnp.pad(b, (0, pad_n))
+    mp, np_ = m + pad_m, n + pad_n
+    b2 = b.reshape(1, np_)
+    out = pl.pallas_call(
+        _dense_relu_kernel,
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        interpret=interpret,
+    )(x, w, b2)
+    return out[:m, :n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def dense_relu(x: jax.Array, w: jax.Array, b: jax.Array,
+               interpret: bool = False) -> jax.Array:
+    """relu(x @ w + b) with the forward fused in a single Pallas kernel."""
+    return _dense_relu_fwd_pallas(x, w, b, interpret)
+
+
+def _fwd(x, w, b, interpret):
+    y = _dense_relu_fwd_pallas(x, w, b, interpret)
+    return y, (x, w, y)
+
+
+def _bwd(interpret, res, g):
+    x, w, y = res
+    g = jnp.where(y > 0, g, 0).astype(jnp.float32)
+    dx = (g @ w.astype(jnp.float32).T).astype(x.dtype)
+    dw = (x.astype(jnp.float32).T @ g).astype(w.dtype)
+    db = g.sum(axis=0).astype(w.dtype)
+    return dx, dw, db
+
+
+dense_relu.defvjp(_fwd, _bwd)
+
+
+@jax.jit
+def dense_relu_reference(x, w, b):
+    """XLA reference implementation — the equivalence oracle in tests."""
+    return jnp.maximum(x @ w + b, 0.0)

@@ -1,0 +1,28 @@
+"""Loss and eval math — the reference's cross-entropy / test-accuracy path
+[BASELINE.json metric: "wall-clock to 99% test accuracy"].
+
+Numerics live in float32 regardless of compute dtype: logits produced in
+bfloat16 are upcast before the log-softmax so the loss/accuracy thresholds
+(the 99% target) are not perturbed by low-precision reductions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels, in f32."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels).mean()
+
+
+def accuracy_count(logits: jnp.ndarray, labels: jnp.ndarray,
+                   valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Number of correct predictions (int32). `valid` is an optional bool
+    mask used by the padded-tail eval batches (data/loader.eval_batches)."""
+    hit = (jnp.argmax(logits, axis=-1) == labels)
+    if valid is not None:
+        hit = jnp.logical_and(hit, valid)
+    return hit.sum(dtype=jnp.int32)

@@ -1,0 +1,21 @@
+"""Optimizer factory — the reference's SGD and Adam
+[BASELINE.json configs 1 (SGD) and 2/4/5 (Adam); SURVEY.md §2 rows 4-5].
+
+optax transforms are pure pytree->pytree functions, so the optimizer update
+compiles into the same fused XLA program as forward/backward/psum — there is
+no separate "optimizer.step()" host call as in the reference's hot loop
+(SURVEY.md §3.1 vs §3.2).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def build(name: str, learning_rate: float, momentum: float = 0.9
+          ) -> optax.GradientTransformation:
+    if name == "sgd":
+        return optax.sgd(learning_rate, momentum=momentum)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    raise ValueError(f"unknown optimizer {name!r} (expected sgd|adam)")

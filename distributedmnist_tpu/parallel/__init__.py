@@ -1,0 +1,7 @@
+from distributedmnist_tpu.parallel.mesh import (  # noqa: F401
+    get_devices,
+    make_mesh,
+    replicated,
+    batch_sharded,
+)
+from distributedmnist_tpu.parallel import distributed  # noqa: F401

@@ -1,0 +1,81 @@
+"""Multi-host bring-up — TPU-native replacement for the reference's NCCL
+process-group init / rendezvous [BASELINE.json configs 3-5; SURVEY.md §2
+rows 8-9].
+
+Single host needs nothing: one process sees all local chips and XLA's
+collectives ride ICI. Multi-host (config 5: "multi-host v4-32 data-parallel
+LeNet-5") uses `jax.distributed.initialize` for the DCN rendezvous — the
+equivalent of the reference's NCCL bootstrap, but after it everything is
+still ONE logical program: a jitted step over a global mesh whose psum XLA
+partitions over ICI+DCN.
+
+Per-process data: each process loads/generates the full (tiny) dataset and
+the full global index array, then `global_batch_indices` assembles a global
+jax.Array from each process's addressable slice via
+`jax.make_array_from_process_local_data` — the replacement for the
+reference's shard-by-rank DataLoader at multi-host scale.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributedmnist_tpu.parallel.mesh import DATA_AXIS
+
+
+def maybe_initialize(coordinator_address: Optional[str],
+                     num_processes: Optional[int],
+                     process_id: Optional[int]) -> bool:
+    """Rendezvous with the other hosts iff multi-host flags are present.
+
+    Returns True when running multi-host. Idempotent-safe for tests: raises
+    cleanly if jax.distributed was already initialized.
+    """
+    if coordinator_address is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_batch_indices(idx: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Build the sharded global index array for one step.
+
+    Single-process: a plain device_put with the P('data') layout. Multi-
+    process: every process computed the same global `idx` (seeded stream);
+    each contributes its process-local slice and jax assembles the global
+    array without any cross-host data movement.
+    """
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    if jax.process_count() == 1:
+        return jax.device_put(idx, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, _local_slice(idx, sharding), global_shape=idx.shape)
+
+
+def _local_slice(idx: np.ndarray, sharding: NamedSharding) -> np.ndarray:
+    """The rows of the global array this process's devices own."""
+    local_idx = [
+        s for d, s in sharding.addressable_devices_indices_map(idx.shape).items()
+    ]
+    # All addressable shards of a 1-D P('data') layout form one contiguous
+    # range per process; take the union of row slices.
+    starts = [s[0].start or 0 for s in local_idx]
+    stops = [s[0].stop if s[0].stop is not None else idx.shape[0]
+             for s in local_idx]
+    return idx[min(starts):max(stops)]

@@ -1,0 +1,61 @@
+"""Device mesh construction — the TPU-native replacement for the reference's
+NCCL process group [BASELINE.json north_star: "the per-step NCCL gradient
+allreduce maps to lax.psum over a named ICI device mesh"].
+
+The mesh has a single named axis 'data' because data parallelism is the
+reference's only parallelism strategy (SURVEY.md §2 parallelism table). All
+sharding in the framework is expressed against this axis; collectives over
+it ride ICI within a host and DCN across hosts, inserted by XLA.
+
+Device selection honors the reference's `--device` flag [north_star: "the
+existing train.py entrypoint gains a --device=tpu flag"]: 'cpu' targets the
+always-present CPU backend (with XLA_FLAGS=--xla_force_host_platform_
+device_count=N giving N virtual devices — the multi-chip test strategy,
+SURVEY.md §3.4), 'tpu' requires real TPU chips, 'auto' takes the default
+backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def get_devices(device: str = "auto",
+                num_devices: Optional[int] = None) -> list:
+    if device == "auto":
+        devs = jax.devices()
+    elif device == "cpu":
+        devs = jax.devices("cpu")
+    elif device == "tpu":
+        devs = [d for d in jax.devices() if d.platform == "tpu"]
+        if not devs:
+            raise RuntimeError("--device=tpu requested but no TPU visible")
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise RuntimeError(
+                f"requested {num_devices} devices, only {len(devs)} visible "
+                f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                f"with --device=cpu for virtual devices)")
+        devs = devs[:num_devices]
+    return devs
+
+
+def make_mesh(devices: Sequence) -> Mesh:
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Leading axis split over 'data', remaining axes replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))

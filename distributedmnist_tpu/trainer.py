@@ -1,0 +1,305 @@
+"""Trainer: the fused XLA training step and the host-side fit loop.
+
+Reference parity [BASELINE.json north_star]: "the LeNet/MLP forward-backward
+becomes a jax.jit-compiled step function, the per-step NCCL gradient
+allreduce maps to lax.psum over a named ICI device mesh". The reference's
+hot loop (SURVEY.md §3.1) runs forward / backward / NCCL-allreduce /
+optimizer.step as four host-driven phases; here all four are ONE compiled
+XLA program (SURVEY.md §3.2) and the host only dispatches.
+
+Two SPMD modes, equivalence-tested against each other:
+
+- "auto": `jax.jit` with sharded inputs — the batch arrives sharded over
+  'data', params replicated; XLA's sharding propagation inserts the gradient
+  all-reduce. The modern idiomatic form.
+- "explicit": `shard_map` with a hand-written `lax.pmean(grads, 'data')` —
+  the literal TPU translation of the reference's per-step allreduce, kept
+  both as documentation of where the collective lives and as a test oracle.
+
+The batch is selected ON DEVICE: the step takes the full device-resident
+uint8 dataset plus a sharded index array, gathers, normalizes, and the
+gather/normalize fuse into the first conv/matmul. No pixels cross the host
+boundary in the hot loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributedmnist_tpu import models, optim
+from distributedmnist_tpu.config import Config
+from distributedmnist_tpu.data import DeviceDataset, IndexStream, load_mnist
+from distributedmnist_tpu.data.loader import eval_batches
+from distributedmnist_tpu.ops import accuracy_count, cross_entropy
+from distributedmnist_tpu.parallel import distributed, get_devices, make_mesh
+from distributedmnist_tpu.parallel.mesh import DATA_AXIS, replicated
+from distributedmnist_tpu.utils import MetricsLogger, StepTimer, round_up
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+log = logging.getLogger("distributedmnist_tpu")
+
+
+class SimulatedFailure(RuntimeError):
+    """Raised by the --fail-at-step fault-injection hook (SURVEY.md §5)."""
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array            # int32 scalar
+    params: Any
+    opt_state: Any
+
+
+def init_state(rng: jax.Array, model, tx: optax.GradientTransformation,
+               sample: jax.Array) -> TrainState:
+    params = model.init(rng, sample)["params"]
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=tx.init(params))
+
+
+def _forward_loss(model, dtype):
+    def loss_fn(params, x_u8, y):
+        x = x_u8.astype(dtype) / jnp.asarray(255.0, dtype)
+        logits = model.apply({"params": params}, x)
+        return cross_entropy(logits, y)
+    return loss_fn
+
+
+def make_train_step(model, tx, mesh, mode: str = "auto",
+                    dtype=jnp.float32):
+    """Build the jitted train step: (state, train_x, train_y, idx) ->
+    (state, metrics). `idx` is the global-batch index array sharded over
+    'data'; the dataset arrays are replicated."""
+    loss_fn = _forward_loss(model, dtype)
+
+    if mode == "auto":
+        batch_spec = NamedSharding(mesh, P(DATA_AXIS))
+
+        def _step(state, train_x, train_y, idx):
+            x = jax.lax.with_sharding_constraint(
+                jnp.take(train_x, idx, axis=0), batch_spec)
+            y = jax.lax.with_sharding_constraint(
+                jnp.take(train_y, idx, axis=0), batch_spec)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            new = TrainState(step=state.step + 1, params=params,
+                             opt_state=opt_state)
+            return new, {"loss": loss}
+
+        return jax.jit(_step, donate_argnums=0)
+
+    if mode != "explicit":
+        raise ValueError(f"unknown spmd mode {mode!r}")
+
+    # explicit: the reference's per-step gradient allreduce, spelled out as
+    # lax.pmean over the named 'data' axis inside shard_map [north_star].
+    def _local_step(state, train_x, train_y, idx):
+        x = jnp.take(train_x, idx, axis=0)   # idx is the LOCAL shard here
+        y = jnp.take(train_y, idx, axis=0)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
+        # Equal shard sizes (enforced at config time) make pmean-of-means
+        # the exact global mean.
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new = TrainState(step=state.step + 1, params=params,
+                         opt_state=opt_state)
+        return new, {"loss": loss}
+
+    smapped = shard_map(
+        _local_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=0)
+
+
+def make_eval_fn(model, mesh, dtype=jnp.float32):
+    """Jitted full-test-set accuracy: scan over index batches, each batch
+    sharded over 'data'; the correct-count reduction crosses devices via an
+    XLA-inserted psum. Returns the int32 number of correct predictions."""
+    batch_spec = NamedSharding(mesh, P(None, DATA_AXIS))
+    del batch_spec  # inputs arrive pre-sharded; constraint not needed
+
+    def _eval(params, test_x, test_y, idx_mat, mask_mat):
+        def body(correct, xs):
+            idx, mask = xs
+            x = jnp.take(test_x, idx, axis=0).astype(dtype) / 255.0
+            y = jnp.take(test_y, idx, axis=0)
+            logits = model.apply({"params": params}, x)
+            return correct + accuracy_count(logits, y, mask), None
+
+        correct, _ = jax.lax.scan(body, jnp.zeros((), jnp.int32),
+                                  (idx_mat, mask_mat))
+        return correct
+
+    return jax.jit(_eval)
+
+
+def fit(cfg: Config, data: Optional[dict] = None) -> dict:
+    """Run one training workload end-to-end; returns the summary dict whose
+    JSON form is the driver-facing result (SURVEY.md §2 row 11)."""
+    from distributedmnist_tpu.checkpoint import Checkpointer  # lazy: orbax
+
+    multihost = distributed.maybe_initialize(
+        cfg.coordinator_address, cfg.num_processes, cfg.process_id)
+    devices = get_devices(cfg.device, cfg.num_devices)
+    n_chips = len(devices)
+    if cfg.batch_size % n_chips:
+        raise ValueError(
+            f"global batch {cfg.batch_size} not divisible by {n_chips} chips")
+    mesh = make_mesh(devices)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+    data = data if data is not None else load_mnist(
+        cfg.data_dir, cfg.synthetic, cfg.seed)
+    ds = DeviceDataset(data, mesh)
+
+    model = models.build(cfg.model, dtype=dtype, fused=cfg.fused_kernels,
+                         platform=devices[0].platform)
+    tx = optim.build(cfg.optimizer, cfg.learning_rate, cfg.momentum)
+    rng = jax.random.PRNGKey(cfg.seed)
+    sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
+    state = jax.device_put(init_state(rng, model, tx, sample),
+                           replicated(mesh))
+
+    ckpt = None
+    restored = False
+    if cfg.checkpoint_dir:
+        ckpt = Checkpointer(cfg.checkpoint_dir)
+        if cfg.resume:
+            state, restored = ckpt.maybe_restore(state)
+            if restored:
+                log.info("restored checkpoint at step %d", int(state.step))
+
+    start_step = int(state.step)
+    steps_per_epoch = ds.train_n // cfg.batch_size
+    total_steps = cfg.steps if cfg.steps is not None \
+        else cfg.epochs * steps_per_epoch
+    stream = IndexStream(ds.train_n, cfg.batch_size, cfg.seed, mesh,
+                         start_step=start_step)
+
+    step_fn = make_train_step(model, tx, mesh, cfg.spmd_mode, dtype)
+    eval_fn = make_eval_fn(model, mesh, dtype)
+    eb = round_up(min(2048, ds.test_n), n_chips)
+    idx_mat, mask_mat = eval_batches(ds.test_n, eb)
+    eval_spec = NamedSharding(mesh, P(None, DATA_AXIS))
+    idx_mat = jax.device_put(idx_mat, eval_spec)
+    mask_mat = jax.device_put(mask_mat, eval_spec)
+
+    def evaluate(state) -> float:
+        # Inside timer.exclude(): eval seconds must not deflate the
+        # training-throughput metric (the BASELINE headline number).
+        with timer.exclude():
+            correct = eval_fn(state.params, ds.test_x, ds.test_y,
+                              idx_mat, mask_mat)
+            return float(correct) / ds.test_n
+
+    # Bound async dispatch depth: JAX dispatch is async, so without a cap
+    # the host can enqueue hundreds of concurrent executions. On TPU a deep
+    # window keeps the pipeline full; on the CPU backend concurrent
+    # programs containing collectives can starve the (num_cores-sized)
+    # thread pool and deadlock the all-reduce rendezvous, so cap at 1.
+    if cfg.max_inflight is not None:
+        max_inflight = cfg.max_inflight
+    elif devices[0].platform == "cpu":
+        max_inflight = 1
+    else:
+        max_inflight = 16
+    inflight: deque = deque()
+
+    timer = StepTimer(cfg.batch_size, n_chips)
+    mlog = MetricsLogger(cfg.log_every)
+    t_start = time.perf_counter()
+    accuracy = 0.0
+    reached_target_at: Optional[float] = None
+    profiling = False
+    if cfg.profile_dir and jax.process_index() == 0:
+        jax.profiler.start_trace(cfg.profile_dir)
+        profiling = True
+
+    step = start_step
+    try:
+        for step in range(start_step, total_steps):
+            idx = next(stream)
+            # Block BEFORE dispatching so at most max_inflight programs are
+            # ever concurrently in flight (cap 1 on CPU really means 1).
+            while len(inflight) >= max_inflight:
+                jax.block_until_ready(inflight.popleft())
+            state, metrics = step_fn(state, ds.train_x, ds.train_y, idx)
+            inflight.append(metrics["loss"])
+            if step == start_step:
+                timer.start(sync=metrics["loss"])  # excludes compile time
+            else:
+                timer.lap()
+            mlog.step(step, {"loss": metrics["loss"]})
+
+            if ckpt and (step + 1) % cfg.checkpoint_every == 0:
+                with timer.exclude():
+                    ckpt.save(step + 1, state)  # async; overlaps next steps
+
+            if cfg.fail_at_step is not None and step + 1 >= cfg.fail_at_step:
+                if ckpt:
+                    ckpt.wait()
+                raise SimulatedFailure(f"injected failure at step {step + 1}")
+
+            if (step + 1) % cfg.eval_every == 0 or step + 1 == total_steps:
+                accuracy = evaluate(state)
+                mlog.eval(step + 1, accuracy)
+                if (cfg.target_accuracy is not None
+                        and accuracy >= cfg.target_accuracy):
+                    reached_target_at = time.perf_counter() - t_start
+                    log.info("target accuracy %.3f reached at step %d "
+                             "(%.2fs)", cfg.target_accuracy, step + 1,
+                             reached_target_at)
+                    break
+    finally:
+        if profiling:
+            jax.profiler.stop_trace()
+
+    if accuracy == 0.0:
+        accuracy = evaluate(state)
+    throughput = timer.snapshot(sync=state.params)
+    wall = time.perf_counter() - t_start
+
+    if ckpt:
+        ckpt.save(int(state.step), state, force=True)
+        ckpt.wait()
+        ckpt.close()
+
+    summary = {
+        "model": cfg.model,
+        "optimizer": cfg.optimizer,
+        "spmd_mode": cfg.spmd_mode,
+        "n_chips": n_chips,
+        "n_processes": jax.process_count(),
+        "multihost": multihost,
+        "global_batch": cfg.batch_size,
+        "data": ds.source,
+        "steps": int(state.step),
+        "restored": restored,
+        "test_accuracy": accuracy,
+        "target_accuracy": cfg.target_accuracy,
+        "wall_clock_s": wall,
+        "wall_clock_to_target_s": reached_target_at,
+        **throughput,
+    }
+    log.info("summary %s", MetricsLogger.summary_line(summary))
+    return summary

@@ -1,0 +1,2 @@
+from distributedmnist_tpu.utils.metrics import MetricsLogger, StepTimer  # noqa: F401
+from distributedmnist_tpu.utils.numerics import round_up  # noqa: F401

@@ -1,0 +1,96 @@
+"""Metrics / observability — images/sec/chip, wall-clock-to-target-accuracy,
+machine-readable JSON summary [BASELINE.json metric: "MNIST images/sec/chip;
+wall-clock to 99% test accuracy"; SURVEY.md §2 row 11, §5].
+
+Timing respects JAX's async dispatch: StepTimer only closes a window after a
+`jax.block_until_ready` on the last step's output, so measured step time is
+device time + dispatch, not just host dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger("distributedmnist_tpu")
+
+
+class StepTimer:
+    """Throughput accounting over the hot loop, excluding compile.
+
+    Call start() after warmup (first step compiled), lap() each step; the
+    first lap after start() sets t0. images/sec/chip = images / elapsed /
+    n_chips.
+    """
+
+    def __init__(self, global_batch: int, n_chips: int):
+        self.global_batch = global_batch
+        self.n_chips = n_chips
+        self.t0: Optional[float] = None
+        self.steps = 0
+        self.excluded = 0.0
+
+    def start(self, sync: Any = None) -> None:
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self.t0 = time.perf_counter()
+        self.steps = 0
+        self.excluded = 0.0
+
+    def lap(self) -> None:
+        self.steps += 1
+
+    @contextlib.contextmanager
+    def exclude(self):
+        """Exclude a non-training span (eval, checkpoint IO) from the
+        throughput window."""
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.excluded += time.perf_counter() - t
+
+    def snapshot(self, sync: Any = None) -> dict:
+        if sync is not None:
+            jax.block_until_ready(sync)
+        elapsed = (time.perf_counter() - (self.t0 or time.perf_counter())
+                   - self.excluded)
+        images = self.steps * self.global_batch
+        ips = images / elapsed if elapsed > 0 else 0.0
+        return {
+            "elapsed_s": elapsed,
+            "steps_timed": self.steps,
+            "images_per_sec": ips,
+            "images_per_sec_per_chip": ips / max(self.n_chips, 1),
+            "step_ms": 1000.0 * elapsed / self.steps if self.steps else 0.0,
+        }
+
+
+class MetricsLogger:
+    """Per-step scalar log + final JSON line for the driver harness."""
+
+    def __init__(self, log_every: int = 100):
+        self.log_every = log_every
+        self.history: list[dict] = []
+
+    def step(self, step: int, scalars: dict) -> None:
+        if self.log_every and step % self.log_every == 0:
+            rec = {"step": step}
+            rec.update({k: float(v) for k, v in scalars.items()})
+            self.history.append(rec)
+            log.info("step %6d  %s", step,
+                     "  ".join(f"{k}={v:.4g}" for k, v in rec.items()
+                               if k != "step"))
+
+    def eval(self, step: int, accuracy: float) -> None:
+        log.info("eval step %6d  test_accuracy=%.4f", step, accuracy)
+        self.history.append({"step": step, "test_accuracy": float(accuracy)})
+
+    @staticmethod
+    def summary_line(summary: dict) -> str:
+        return json.dumps(summary, sort_keys=True)

@@ -1,0 +1,8 @@
+"""Small shared numeric helpers."""
+
+from __future__ import annotations
+
+
+def round_up(x: int, multiple: int) -> int:
+    """Smallest multiple of `multiple` that is >= x."""
+    return ((x + multiple - 1) // multiple) * multiple

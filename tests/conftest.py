@@ -1,0 +1,50 @@
+"""Test bootstrap: force an 8-virtual-device CPU mesh (SURVEY.md §4).
+
+All distributed tests run the REAL mesh/psum/sharding code path on 8 fake
+CPU devices via --xla_force_host_platform_device_count. The env's axon
+sitecustomize may have already imported jax and pinned JAX_PLATFORMS=axon,
+so the platform is also overridden post-import via jax.config — that works
+even when the TPU tunnel is unreachable.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from distributedmnist_tpu.data import synthetic_mnist  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, (
+        "conftest expected 8 virtual CPU devices; got "
+        f"{len(devs)} — was jax initialized before conftest ran?")
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def tiny_data():
+    """Small synthetic dataset shared across tests (fast)."""
+    return synthetic_mnist(seed=0, train_n=2048, test_n=512)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

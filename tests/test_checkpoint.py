@@ -1,0 +1,75 @@
+"""Checkpoint tests (SURVEY.md §4): async save -> restore round-trips the
+exact training state (params, opt_state, step), latest-step selection, and
+no-checkpoint no-op."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedmnist_tpu import models, optim
+from distributedmnist_tpu.checkpoint import Checkpointer
+from distributedmnist_tpu.parallel import make_mesh, replicated
+from distributedmnist_tpu.trainer import TrainState, init_state
+
+
+def _state(eight_devices, step=0):
+    mesh = make_mesh(eight_devices)
+    model = models.build("mlp", fused="xla")
+    tx = optim.build("adam", 1e-3)
+    state = init_state(jax.random.PRNGKey(7), model, tx,
+                       jnp.zeros((1, 28, 28, 1)))
+    state = state.replace(step=jnp.asarray(step, jnp.int32))
+    return jax.device_put(state, replicated(mesh))
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip(tmp_path, eight_devices):
+    state = _state(eight_devices, step=42)
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    ckpt.save(42, state)
+    ckpt.wait()
+    ckpt.close()
+
+    fresh = _state(eight_devices, step=0)  # different contents (step differs)
+    ckpt2 = Checkpointer(str(tmp_path / "ckpt"))
+    restored, ok = ckpt2.maybe_restore(fresh)
+    ckpt2.close()
+    assert ok
+    assert int(restored.step) == 42
+    _assert_tree_equal(restored, state)
+    # restore preserved shardings (replicated over the mesh)
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert len(leaf.sharding.device_set) == 8
+
+
+def test_restore_picks_latest(tmp_path, eight_devices):
+    ckpt = Checkpointer(str(tmp_path / "c"))
+    for step in (5, 10, 15):
+        ckpt.save(step, _state(eight_devices, step=step))
+    ckpt.wait()
+    restored, ok = ckpt.maybe_restore(_state(eight_devices))
+    ckpt.close()
+    assert ok and int(restored.step) == 15
+
+
+def test_restore_empty_dir_is_noop(tmp_path, eight_devices):
+    state = _state(eight_devices, step=3)
+    ckpt = Checkpointer(str(tmp_path / "empty"))
+    restored, ok = ckpt.maybe_restore(state)
+    ckpt.close()
+    assert not ok
+    assert restored is state
+
+
+def test_max_to_keep_garbage_collects(tmp_path, eight_devices):
+    ckpt = Checkpointer(str(tmp_path / "gc"), max_to_keep=2)
+    for step in (1, 2, 3, 4):
+        ckpt.save(step, _state(eight_devices, step=step))
+    ckpt.wait()
+    steps = sorted(ckpt.mgr.all_steps())
+    ckpt.close()
+    assert steps == [3, 4]

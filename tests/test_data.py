@@ -1,0 +1,136 @@
+"""Data layer tests (SURVEY.md §4): IDX round-trip, synthetic determinism,
+epoch permutation semantics, and shard-partition invariants."""
+
+import gzip
+import os
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.data import load_mnist, synthetic_mnist
+from distributedmnist_tpu.data.loader import (
+    DeviceDataset, IndexStream, eval_batches)
+from distributedmnist_tpu.parallel import make_mesh
+
+
+def _write_idx(path, arr, gz=False):
+    dims = arr.shape
+    header = struct.pack(f">I{len(dims)}I", 0x0800 | len(dims), *dims)
+    opener = gzip.open if gz else open
+    with opener(path, "wb") as f:
+        f.write(header)
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_idx_roundtrip(tmp_path, gz):
+    rng = np.random.default_rng(0)
+    data = {
+        "train-images-idx3-ubyte": rng.integers(0, 255, (100, 28, 28)),
+        "train-labels-idx1-ubyte": rng.integers(0, 10, (100,)),
+        "t10k-images-idx3-ubyte": rng.integers(0, 255, (50, 28, 28)),
+        "t10k-labels-idx1-ubyte": rng.integers(0, 10, (50,)),
+    }
+    for name, arr in data.items():
+        _write_idx(os.path.join(tmp_path, name + (".gz" if gz else "")),
+                   arr, gz=gz)
+    out = load_mnist(data_dir=str(tmp_path))
+    assert out["source"] == "real"
+    assert out["train_x"].shape == (100, 28, 28, 1)
+    np.testing.assert_array_equal(
+        out["train_x"][..., 0], data["train-images-idx3-ubyte"])
+    np.testing.assert_array_equal(
+        out["test_y"], data["t10k-labels-idx1-ubyte"])
+
+
+def test_npz_loading(tmp_path):
+    rng = np.random.default_rng(0)
+    np.savez(os.path.join(tmp_path, "mnist.npz"),
+             x_train=rng.integers(0, 255, (64, 28, 28), dtype=np.uint8),
+             y_train=rng.integers(0, 10, (64,)),
+             x_test=rng.integers(0, 255, (32, 28, 28), dtype=np.uint8),
+             y_test=rng.integers(0, 10, (32,)))
+    out = load_mnist(data_dir=str(tmp_path))
+    assert out["train_x"].shape == (64, 28, 28, 1)
+    assert out["train_y"].dtype == np.int32
+
+
+def test_missing_data_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_mnist(data_dir=str(tmp_path / "nope"))
+
+
+def test_synthetic_deterministic():
+    a = synthetic_mnist(seed=3, train_n=256, test_n=64)
+    b = synthetic_mnist(seed=3, train_n=256, test_n=64)
+    np.testing.assert_array_equal(a["train_x"], b["train_x"])
+    np.testing.assert_array_equal(a["test_y"], b["test_y"])
+    c = synthetic_mnist(seed=4, train_n=256, test_n=64)
+    assert not np.array_equal(a["train_x"], c["train_x"])
+
+
+def test_synthetic_shapes_and_balance():
+    d = synthetic_mnist(seed=0, train_n=4096, test_n=512)
+    assert d["train_x"].shape == (4096, 28, 28, 1)
+    assert d["train_x"].dtype == np.uint8
+    counts = np.bincount(d["train_y"], minlength=10)
+    assert counts.min() > 200  # roughly balanced classes
+
+
+def test_index_stream_is_epoch_partition(tiny_data, eight_devices):
+    mesh = make_mesh(eight_devices)
+    n, gb = 2048, 256
+    stream = IndexStream(n, gb, seed=0, mesh=mesh)
+    spe = stream.steps_per_epoch
+    assert spe == 8
+    epoch0 = np.concatenate(
+        [stream.indices_for_step(s) for s in range(spe)])
+    # each epoch visits every sample exactly once (partition invariant)
+    assert sorted(epoch0.tolist()) == list(range(n))
+    epoch1 = np.concatenate(
+        [stream.indices_for_step(spe + s) for s in range(spe)])
+    assert sorted(epoch1.tolist()) == list(range(n))
+    assert not np.array_equal(epoch0, epoch1)  # reshuffled between epochs
+
+
+def test_index_stream_device_count_invariant(tiny_data, eight_devices):
+    """Batch order must not depend on the mesh size (SURVEY.md §7.3:
+    seed-for-seed 1-chip ≡ N-chip)."""
+    m1 = make_mesh(eight_devices[:1])
+    m8 = make_mesh(eight_devices)
+    s1 = IndexStream(2048, 256, seed=5, mesh=m1)
+    s8 = IndexStream(2048, 256, seed=5, mesh=m8)
+    for step in (0, 1, 7, 8, 100):
+        np.testing.assert_array_equal(
+            s1.indices_for_step(step), s8.indices_for_step(step))
+
+
+def test_index_stream_sharded_batch(eight_devices):
+    mesh = make_mesh(eight_devices)
+    stream = IndexStream(2048, 256, seed=0, mesh=mesh)
+    idx = next(stream)
+    assert idx.shape == (256,)
+    # sharded over 'data': each device holds 256/8 rows
+    shard_rows = {s.data.shape[0] for s in idx.addressable_shards}
+    assert shard_rows == {32}
+
+
+def test_device_dataset_replicated(tiny_data, eight_devices):
+    mesh = make_mesh(eight_devices)
+    ds = DeviceDataset(tiny_data, mesh)
+    assert ds.train_n == 2048 and ds.test_n == 512
+    # replicated: every device holds the full array
+    assert all(s.data.shape == ds.train_x.shape
+               for s in ds.train_x.addressable_shards)
+    assert ds.train_x.dtype == np.uint8  # stays uint8 until in-step cast
+
+
+def test_eval_batches_mask():
+    idx, mask = eval_batches(test_n=1000, batch=512)
+    assert idx.shape == (2, 512) and mask.shape == (2, 512)
+    assert mask.sum() == 1000
+    valid = idx[mask]
+    assert sorted(valid.tolist()) == list(range(1000))
+    assert (idx[~mask] == 0).all()

@@ -1,0 +1,77 @@
+"""Model unit tests (SURVEY.md §4): pinned parameter counts, output shapes,
+and one-batch overfitting (loss decreases)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributedmnist_tpu import models, optim
+from distributedmnist_tpu.ops import cross_entropy
+
+
+def _n_params(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+def _init(name, **kw):
+    model = models.build(name, **kw)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 28, 28, 1)))["params"]
+    return model, params
+
+
+def test_mlp_param_count():
+    # 784*128+128 + 128*10+10 — the spec's "2-layer MLP (784-128-10)"
+    _, params = _init("mlp", fused="xla")
+    assert _n_params(params) == 101_770
+
+
+def test_mlp_fused_param_count_matches():
+    _, params = _init("mlp", fused="pallas")
+    assert _n_params(params) == 101_770
+
+
+def test_lenet_param_count():
+    _, params = _init("lenet")
+    assert _n_params(params) == 61_706
+
+
+@pytest.mark.parametrize("name", ["mlp", "lenet"])
+def test_forward_shapes(name):
+    model, params = _init(name)
+    x = jnp.zeros((32, 28, 28, 1))
+    logits = model.apply({"params": params}, x)
+    assert logits.shape == (32, 10)
+    assert jnp.isfinite(logits).all()
+
+
+@pytest.mark.parametrize("name,opt", [("mlp", "sgd"), ("lenet", "adam")])
+def test_overfit_one_batch(name, opt):
+    model, params = _init(name)
+    tx = optim.build(opt, 0.05 if opt == "sgd" else 3e-3)
+    opt_state = tx.init(params)
+    key = jax.random.PRNGKey(1)
+    x = jax.random.uniform(key, (64, 28, 28, 1))
+    y = jax.random.randint(key, (64,), 0, 10)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: cross_entropy(model.apply({"params": p}, x), y))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(80):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_bfloat16_forward():
+    model, params = _init("lenet", dtype=jnp.bfloat16)
+    x = jnp.zeros((8, 28, 28, 1), jnp.bfloat16)
+    logits = model.apply({"params": params}, x)
+    assert logits.dtype == jnp.bfloat16

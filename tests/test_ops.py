@@ -1,0 +1,95 @@
+"""Ops tests: loss numerics and the Pallas fused dense+relu kernel
+(interpret mode on CPU) against its XLA oracle, values and gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.ops import accuracy_count, cross_entropy
+from distributedmnist_tpu.ops import fused as fused_lib
+from distributedmnist_tpu.ops.fused import dense_relu, dense_relu_reference
+
+
+def _dr(x, w, b):
+    # interpret=True: tests run on the CPU backend
+    return dense_relu(x, w, b, True)
+
+
+def test_resolve_modes():
+    assert fused_lib.resolve("auto", "tpu") == fused_lib.PALLAS
+    assert fused_lib.resolve("auto", "cpu") == fused_lib.XLA
+    assert fused_lib.resolve("pallas", "cpu") == fused_lib.PALLAS_INTERPRET
+    assert fused_lib.resolve("pallas", "tpu") == fused_lib.PALLAS
+    assert fused_lib.resolve("xla", "tpu") == fused_lib.XLA
+
+
+def test_cross_entropy_matches_manual():
+    logits = jnp.array([[2.0, 0.0, -1.0], [0.5, 0.5, 0.5]])
+    labels = jnp.array([0, 2])
+    got = cross_entropy(logits, labels)
+    p = jax.nn.log_softmax(logits)
+    want = -(p[0, 0] + p[1, 2]) / 2
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cross_entropy_bf16_logits_f32_loss():
+    logits = jnp.zeros((4, 10), jnp.bfloat16)
+    labels = jnp.zeros((4,), jnp.int32)
+    loss = cross_entropy(logits, labels)
+    assert loss.dtype == jnp.float32
+    np.testing.assert_allclose(loss, np.log(10.0), rtol=1e-3)
+
+
+def test_accuracy_count_with_mask():
+    logits = jnp.array([[1.0, 0], [0, 1.0], [1.0, 0], [1.0, 0]])
+    labels = jnp.array([0, 1, 1, 0])
+    assert int(accuracy_count(logits, labels)) == 3
+    mask = jnp.array([True, True, True, False])
+    assert int(accuracy_count(logits, labels, mask)) == 2
+
+
+@pytest.mark.parametrize("m,k,n", [(8, 784, 128), (128, 784, 128),
+                                   (200, 300, 50)])
+def test_fused_dense_relu_matches_xla(m, k, n):
+    key = jax.random.PRNGKey(0)
+    kx, kw, kb = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (m, k))
+    w = jax.random.normal(kw, (k, n)) * 0.05
+    b = jax.random.normal(kb, (n,))
+    got = _dr(x, w, b)                 # interpret mode on CPU
+    want = dense_relu_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_dense_relu_grads_match_xla():
+    key = jax.random.PRNGKey(1)
+    kx, kw, kb = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (32, 64))
+    w = jax.random.normal(kw, (64, 16)) * 0.1
+    b = jax.random.normal(kb, (16,))
+
+    def f_fused(x, w, b):
+        return _dr(x, w, b).sum()
+
+    def f_ref(x, w, b):
+        return dense_relu_reference(x, w, b).sum()
+
+    g_fused = jax.grad(f_fused, argnums=(0, 1, 2))(x, w, b)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, r in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_fused_under_jit_and_vjp_in_train_shape():
+    @jax.jit
+    def step(x, w, b):
+        y, vjp = jax.vjp(_dr, x, w, b)
+        return vjp(jnp.ones_like(y))
+
+    dx, dw, db = step(jnp.ones((64, 784)), jnp.ones((784, 128)) * 0.01,
+                      jnp.zeros((128,)))
+    assert dx.shape == (64, 784) and dw.shape == (784, 128)
+    assert db.shape == (128,)

@@ -1,0 +1,144 @@
+"""Distributed correctness on the 8-virtual-device CPU mesh (SURVEY.md §4):
+the DP invariant (psum-of-shard-grads ≡ single-device grads on the full
+batch), auto ≡ explicit SPMD mode equivalence, and seed-for-seed
+1-device ≡ 8-device training equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedmnist_tpu import models, optim
+from distributedmnist_tpu.data.loader import DeviceDataset, IndexStream
+from distributedmnist_tpu.ops import cross_entropy
+from distributedmnist_tpu.parallel import make_mesh, replicated
+from distributedmnist_tpu.trainer import (
+    TrainState, init_state, make_eval_fn, make_train_step)
+
+
+def _setup(tiny_data, devices, model_name="mlp", opt="sgd", mode="auto",
+           lr=0.1):
+    mesh = make_mesh(devices)
+    ds = DeviceDataset(tiny_data, mesh)
+    model = models.build(model_name, fused="xla")
+    tx = optim.build(opt, lr)
+    state = jax.device_put(
+        init_state(jax.random.PRNGKey(0), model, tx,
+                   jnp.zeros((1, 28, 28, 1))),
+        replicated(mesh))
+    step_fn = make_train_step(model, tx, mesh, mode=mode)
+    return mesh, ds, model, tx, state, step_fn
+
+
+def _run(tiny_data, devices, steps, mode, model_name="mlp", opt="sgd",
+         batch=256, seed=0, lr=0.1):
+    mesh, ds, model, tx, state, step_fn = _setup(
+        tiny_data, devices, model_name, opt, mode, lr)
+    stream = IndexStream(ds.train_n, batch, seed=seed, mesh=mesh)
+    losses = []
+    for _ in range(steps):
+        state, m = step_fn(state, ds.train_x, ds.train_y, next(stream))
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+def test_dp_gradients_match_single_device(tiny_data, eight_devices):
+    """THE data-parallel invariant: gradients from the sharded step equal
+    single-device gradients on the identical global batch."""
+    mesh8 = make_mesh(eight_devices)
+    ds = DeviceDataset(tiny_data, mesh8)
+    model = models.build("mlp", fused="xla")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+
+    idx = np.arange(256, dtype=np.int32)
+    x = tiny_data["train_x"][idx].astype(np.float32) / 255.0
+    y = tiny_data["train_y"][idx]
+
+    def loss_fn(p, x, y):
+        return cross_entropy(model.apply({"params": p}, x), y)
+
+    ref_grads = jax.grad(loss_fn)(params, x, y)  # single-device oracle
+
+    # sharded path: same batch via the device-resident gather
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    params8 = jax.device_put(params, replicated(mesh8))
+    idx8 = jax.device_put(idx, NamedSharding(mesh8, P("data")))
+
+    @jax.jit
+    def sharded_grads(p, train_x, train_y, idx):
+        xb = jnp.take(train_x, idx, axis=0).astype(jnp.float32) / 255.0
+        yb = jnp.take(train_y, idx, axis=0)
+        return jax.grad(loss_fn)(p, xb, yb)
+
+    got = sharded_grads(params8, ds.train_x, ds.train_y, idx8)
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("model_name,opt", [("mlp", "sgd"), ("lenet", "adam")])
+def test_auto_equals_explicit_mode(tiny_data, eight_devices, model_name, opt):
+    """jit+sharding-propagation and shard_map+psum must produce identical
+    training trajectories (same seed, same batches)."""
+    s_auto, l_auto = _run(tiny_data, eight_devices, 5, "auto",
+                          model_name, opt)
+    s_exp, l_exp = _run(tiny_data, eight_devices, 5, "explicit",
+                        model_name, opt)
+    np.testing.assert_allclose(l_auto, l_exp, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_auto.params),
+                    jax.tree.leaves(s_exp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["auto", "explicit"])
+def test_one_dev_equals_eight_dev(tiny_data, eight_devices, mode):
+    """Seed-for-seed 1-chip ≡ 8-chip equivalence (SURVEY.md §7.3) — the
+    global batch order is device-count-independent and the psum'd update
+    equals the single-device update."""
+    s1, l1 = _run(tiny_data, eight_devices[:1], 8, mode)
+    s8, l8 = _run(tiny_data, eight_devices, 8, mode)
+    np.testing.assert_allclose(l1, l8, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_loss_decreases_8dev(tiny_data, eight_devices):
+    _, losses = _run(tiny_data, eight_devices, 32, "auto",
+                     model_name="mlp", opt="sgd", lr=0.02)
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.8
+
+
+def test_eval_fn_counts_correct(tiny_data, eight_devices):
+    from distributedmnist_tpu.data.loader import eval_batches
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(eight_devices)
+    ds = DeviceDataset(tiny_data, mesh)
+    model = models.build("mlp", fused="xla")
+    params = jax.device_put(
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 28, 28, 1)))["params"],
+        replicated(mesh))
+    eval_fn = make_eval_fn(model, mesh)
+    idx_mat, mask_mat = eval_batches(ds.test_n, 128)
+    spec = NamedSharding(mesh, P(None, "data"))
+    correct = int(eval_fn(params, ds.test_x, ds.test_y,
+                          jax.device_put(idx_mat, spec),
+                          jax.device_put(mask_mat, spec)))
+    # oracle: plain numpy/jnp forward over the whole test set
+    logits = model.apply(
+        {"params": params},
+        jnp.asarray(tiny_data["test_x"], jnp.float32) / 255.0)
+    want = int((jnp.argmax(logits, -1) == tiny_data["test_y"]).sum())
+    assert correct == want
+
+
+def test_batch_not_divisible_raises(tiny_data, eight_devices):
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu import trainer
+    cfg = Config(batch_size=100, num_devices=8, device="cpu",
+                 synthetic=True)
+    with pytest.raises(ValueError, match="not divisible"):
+        trainer.fit(cfg, data=tiny_data)

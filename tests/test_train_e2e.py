@@ -1,0 +1,88 @@
+"""End-to-end trainer tests (SURVEY.md §4): full fit() runs on the virtual
+8-device CPU mesh with synthetic data — accuracy threshold, early stop,
+kill/resume recovery via the fault-injection hook, and preset coverage."""
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu import trainer
+from distributedmnist_tpu.config import PRESETS, Config
+from distributedmnist_tpu.data import synthetic_mnist
+
+
+BASE = Config(device="cpu", synthetic=True, log_every=0,
+              target_accuracy=None)
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    return synthetic_mnist(seed=1, train_n=4096, test_n=1024)
+
+
+def test_fit_reaches_accuracy(small_data):
+    cfg = BASE.replace(model="mlp", optimizer="sgd", learning_rate=0.02,
+                       batch_size=256, num_devices=8, steps=200,
+                       eval_every=100, target_accuracy=0.9)
+    out = trainer.fit(cfg, data=small_data)
+    assert out["test_accuracy"] >= 0.9
+    assert out["data"] == "synthetic"
+    assert out["n_chips"] == 8
+    assert out["wall_clock_to_target_s"] is not None
+
+
+def test_fit_explicit_mode_matches_auto(small_data):
+    kw = dict(model="mlp", optimizer="sgd", learning_rate=0.02,
+              batch_size=256, num_devices=8, steps=60, eval_every=60)
+    a = trainer.fit(BASE.replace(spmd_mode="auto", **kw), data=small_data)
+    b = trainer.fit(BASE.replace(spmd_mode="explicit", **kw), data=small_data)
+    np.testing.assert_allclose(a["test_accuracy"], b["test_accuracy"],
+                               atol=1e-6)
+
+
+def test_kill_resume_recovery(small_data, tmp_path):
+    """The failure-recovery story (SURVEY.md §5): crash mid-run via the
+    injection hook, restart, restore from the async checkpoint, finish."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    kw = dict(model="mlp", optimizer="adam", learning_rate=1e-3,
+              batch_size=256, num_devices=8, steps=30, eval_every=1000,
+              checkpoint_dir=ckpt_dir, checkpoint_every=10)
+    with pytest.raises(trainer.SimulatedFailure):
+        trainer.fit(BASE.replace(fail_at_step=20, **kw), data=small_data)
+
+    out = trainer.fit(BASE.replace(**kw), data=small_data)
+    assert out["restored"] is True
+    assert out["steps"] == 30  # resumed from 20, not restarted from 0
+
+
+def test_resume_disabled_starts_fresh(small_data, tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt2")
+    kw = dict(model="mlp", optimizer="sgd", learning_rate=0.02,
+              batch_size=256, num_devices=8, steps=10, eval_every=1000,
+              checkpoint_dir=ckpt_dir, checkpoint_every=5)
+    trainer.fit(BASE.replace(**kw), data=small_data)
+    out = trainer.fit(BASE.replace(resume=False, **kw), data=small_data)
+    assert out["restored"] is False
+
+
+def test_all_presets_construct():
+    # the five BASELINE.json workloads exist and are internally consistent
+    assert set(PRESETS) == {"mlp-sgd", "lenet-adam", "mlp-dp2",
+                            "lenet-dp8", "lenet-multihost"}
+    assert PRESETS["mlp-sgd"].batch_size == 64
+    assert PRESETS["mlp-sgd"].optimizer == "sgd"
+    assert PRESETS["lenet-dp8"].batch_size == 512
+    assert PRESETS["lenet-dp8"].num_devices == 8
+    assert PRESETS["lenet-multihost"].checkpoint_dir is not None
+
+
+def test_cli_args_roundtrip():
+    import argparse
+    from distributedmnist_tpu import config as config_lib
+    p = argparse.ArgumentParser()
+    config_lib.add_args(p)
+    cfg = config_lib.from_args(p.parse_args(
+        ["--preset", "lenet-dp8", "--device", "cpu", "--steps", "5",
+         "--synthetic", "--spmd-mode", "explicit"]))
+    assert cfg.model == "lenet" and cfg.batch_size == 512
+    assert cfg.device == "cpu" and cfg.steps == 5
+    assert cfg.synthetic is True and cfg.spmd_mode == "explicit"

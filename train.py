@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""train.py — the framework's entrypoint, mirroring the reference's
+`train.py` CLI [BASELINE.json north_star: "the existing train.py entrypoint
+gains a --device=tpu flag that selects the JAX path end-to-end with no
+CUDA/NCCL import"]. Here the JAX path is the ONLY path; --device selects
+tpu vs cpu backends over the same SPMD code.
+
+Examples (the five BASELINE.json workloads as presets):
+
+    python train.py --preset mlp-sgd                # config 1
+    python train.py --preset lenet-adam             # config 2
+    python train.py --preset mlp-dp2 --device cpu   # config 3 (virtual devs)
+    python train.py --preset lenet-dp8              # config 4
+    python train.py --preset lenet-multihost \
+        --coordinator-address host0:1234 --num-processes 4 --process-id 0
+                                                    # config 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from distributedmnist_tpu import config as config_lib
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    config_lib.add_args(p)
+    cfg = config_lib.from_args(p.parse_args(argv))
+
+    from distributedmnist_tpu import trainer  # after flags: jax import cost
+    summary = trainer.fit(cfg)
+    print(trainer.MetricsLogger.summary_line(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
