@@ -36,7 +36,16 @@ _IDX_FILES = {
 
 
 def _read_idx(path: str) -> np.ndarray:
-    """Parse an IDX file (the MNIST distribution format), raw or gzipped."""
+    """Parse an IDX file (the MNIST distribution format), raw or gzipped.
+
+    Raw files go through the native C++ reader when the toolchain built it
+    (data/native/); gzipped files and toolchain-less environments use this
+    Python parser. Both produce identical arrays (tested)."""
+    if not path.endswith(".gz"):
+        from distributedmnist_tpu.data import native
+        arr = native.read_idx(path) if native.available() else None
+        if arr is not None:
+            return arr
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">I", f.read(4))[0]

@@ -1,0 +1,127 @@
+"""ctypes binding for the native IDX reader (idx_reader.cpp).
+
+The shared library is built explicitly via `ensure_built()` (g++, atomic
+rename, cross-process safe); the data path only USES the library when it is
+already present (`available()` never triggers a compile), so a fresh
+checkout's cold start is never blocked behind a g++ subprocess. Every entry
+point returns None when the library is unavailable and callers fall back to
+the pure-Python parser. See idx_reader.cpp's header comment for why this
+component exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("distributedmnist_tpu")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "idx_reader.cpp")
+_LIB = os.path.join(_DIR, "libidx_reader.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def ensure_built(force: bool = False) -> bool:
+    """Compile the library if missing/stale. Atomic (temp file + rename) so
+    concurrent builders in different processes can race harmlessly — each
+    renames a complete .so into place. Returns availability."""
+    stale = (not os.path.exists(_LIB)
+             or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+    if stale or force:
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)
+        except (OSError, subprocess.SubprocessError) as e:
+            log.info("native idx_reader build failed (%s); Python path "
+                     "remains active", e)
+            return False
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return available()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            lib.idx_probe.restype = ctypes.c_int
+            lib.idx_probe.argtypes = [ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_int),
+                                      ctypes.POINTER(ctypes.c_uint64)]
+            lib.idx_read.restype = ctypes.c_longlong
+            lib.idx_read.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_ubyte),
+                                     ctypes.c_longlong]
+            lib.epoch_perm.restype = ctypes.c_int
+            lib.epoch_perm.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
+                                       ctypes.c_int32,
+                                       ctypes.POINTER(ctypes.c_int32)]
+        except (OSError, AttributeError) as e:
+            # Corrupt/incompatible .so (e.g. interrupted build from an old
+            # version): disable the native path rather than crash loading.
+            log.warning("native idx_reader load failed (%s); using Python "
+                        "path", e)
+            _load_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True iff the already-built library is loadable. Never compiles."""
+    return _load() is not None
+
+
+def read_idx(path: str) -> Optional[np.ndarray]:
+    """Read a raw (non-gzip) IDX file natively; None if the native path is
+    unavailable (caller falls back to the Python parser)."""
+    lib = _load()
+    if lib is None:
+        return None
+    ndim = ctypes.c_int()
+    dims = (ctypes.c_uint64 * 4)()
+    rc = lib.idx_probe(path.encode(), ctypes.byref(ndim), dims)
+    if rc != 0:
+        raise ValueError(f"native idx_probe({path!r}) failed: rc={rc}")
+    shape = tuple(int(dims[i]) for i in range(ndim.value))
+    out = np.empty(shape, dtype=np.uint8)
+    n = lib.idx_read(path.encode(),
+                     out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+                     out.size)
+    if n != out.size:
+        raise ValueError(f"native idx_read({path!r}) failed: rc={n}")
+    return out
+
+
+def epoch_perm(seed: int, epoch: int, n: int) -> Optional[np.ndarray]:
+    """Seeded Fisher-Yates permutation of arange(n); None if unavailable.
+    Library utility for host-side pipelines; the trainer's IndexStream uses
+    the canonical numpy permutation for cross-environment reproducibility."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(n, dtype=np.int32)
+    lib.epoch_perm(ctypes.c_uint64(seed), ctypes.c_uint64(epoch),
+                   ctypes.c_int32(n),
+                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out

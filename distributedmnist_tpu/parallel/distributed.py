@@ -10,10 +10,10 @@ still ONE logical program: a jitted step over a global mesh whose psum XLA
 partitions over ICI+DCN.
 
 Per-process data: each process loads/generates the full (tiny) dataset and
-the full global index array, then `global_batch_indices` assembles a global
-jax.Array from each process's addressable slice via
-`jax.make_array_from_process_local_data` — the replacement for the
-reference's shard-by-rank DataLoader at multi-host scale.
+the full global index array; `put_global` then builds a global jax.Array
+via `jax.make_array_from_callback`, with each process contributing only the
+blocks its addressable devices own — the replacement for the reference's
+shard-by-rank DataLoader at multi-host scale.
 """
 
 from __future__ import annotations
@@ -53,29 +53,30 @@ def process_index() -> int:
     return jax.process_index()
 
 
-def global_batch_indices(idx: np.ndarray, mesh: Mesh) -> jax.Array:
-    """Build the sharded global index array for one step.
+def put_global(arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """Place a host array with an arbitrary sharding, single- OR
+    multi-process.
 
-    Single-process: a plain device_put with the P('data') layout. Multi-
-    process: every process computed the same global `idx` (seeded stream);
-    each contributes its process-local slice and jax assembles the global
-    array without any cross-host data movement.
+    Every process holds the full (tiny — MNIST-scale) host array; each
+    contributes exactly the blocks its addressable devices own, so no
+    cross-host data movement happens. Single-process this is equivalent to
+    device_put but goes through the same code path, keeping the multi-host
+    seam permanently exercised (SURVEY.md §7.3: multi-host correctness must
+    live behind clean, testable seams).
     """
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
     if jax.process_count() == 1:
-        return jax.device_put(idx, sharding)
-    return jax.make_array_from_process_local_data(
-        sharding, _local_slice(idx, sharding), global_shape=idx.shape)
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
 
 
-def _local_slice(idx: np.ndarray, sharding: NamedSharding) -> np.ndarray:
-    """The rows of the global array this process's devices own."""
-    local_idx = [
-        s for d, s in sharding.addressable_devices_indices_map(idx.shape).items()
-    ]
-    # All addressable shards of a 1-D P('data') layout form one contiguous
-    # range per process; take the union of row slices.
-    starts = [s[0].start or 0 for s in local_idx]
-    stops = [s[0].stop if s[0].stop is not None else idx.shape[0]
-             for s in local_idx]
-    return idx[min(starts):max(stops)]
+def put_replicated(arr: np.ndarray, mesh: Mesh) -> jax.Array:
+    return put_global(arr, NamedSharding(mesh, P()))
+
+
+def global_batch_indices(idx: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Sharded global index array for one step. Every process computed the
+    same global `idx` (seeded stream); each device receives its 'data' slice
+    — the multi-host replacement for the reference's shard-by-rank
+    DataLoader [BASELINE.json north_star]."""
+    return put_global(idx, NamedSharding(mesh, P(DATA_AXIS)))

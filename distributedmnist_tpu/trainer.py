@@ -201,8 +201,8 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     eb = round_up(min(2048, ds.test_n), n_chips)
     idx_mat, mask_mat = eval_batches(ds.test_n, eb)
     eval_spec = NamedSharding(mesh, P(None, DATA_AXIS))
-    idx_mat = jax.device_put(idx_mat, eval_spec)
-    mask_mat = jax.device_put(mask_mat, eval_spec)
+    idx_mat = distributed.put_global(idx_mat, eval_spec)
+    mask_mat = distributed.put_global(mask_mat, eval_spec)
 
     def evaluate(state) -> float:
         # Inside timer.exclude(): eval seconds must not deflate the

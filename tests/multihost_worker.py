@@ -1,0 +1,45 @@
+"""Worker process for the localhost multi-host test (SURVEY.md §4:
+multi-host simulated by multiple processes with jax.distributed.initialize
+on localhost ports). Each worker owns 4 virtual CPU devices; N workers form
+one 4N-device global mesh and run the REAL multi-host code path:
+DCN-style rendezvous, per-process batch assembly, global collectives."""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    port = sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4")
+
+    from distributedmnist_tpu import trainer
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.data import synthetic_mnist
+
+    data = synthetic_mnist(seed=1, train_n=1024, test_n=256)
+    cfg = Config(model="mlp", optimizer="sgd", learning_rate=0.02,
+                 batch_size=64, steps=6, eval_every=6, device="cpu",
+                 synthetic=True, log_every=0, target_accuracy=None,
+                 coordinator_address=f"localhost:{port}",
+                 num_processes=num_processes, process_id=process_id)
+    out = trainer.fit(cfg, data=data)
+    print("MHRESULT " + json.dumps({
+        "process_id": process_id,
+        "steps": out["steps"],
+        "accuracy": out["test_accuracy"],
+        "n_chips": out["n_chips"],
+        "n_processes": out["n_processes"],
+        "multihost": out["multihost"],
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

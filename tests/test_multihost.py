@@ -1,0 +1,63 @@
+"""Localhost multi-process test of the multi-host path (BASELINE.json
+config 5 minus the real DCN): 2 processes x 4 virtual devices = one
+8-device global mesh, jax.distributed rendezvous, per-process global-batch
+assembly, cross-process psum."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    port = _free_port()
+    n = 2
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't dial the TPU relay
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(n), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo_root)
+        for i in range(n)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:  # don't leak workers blocked in a rendezvous
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    results = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("MHRESULT ")]
+        assert lines, f"no MHRESULT in output:\n{out[-3000:]}"
+        results.append(json.loads(lines[0][len("MHRESULT "):]))
+
+    for r in results:
+        assert r["multihost"] is True
+        assert r["n_processes"] == 2
+        assert r["n_chips"] == 8  # 2 processes x 4 virtual devices
+        assert r["steps"] == 6
+    # both processes computed the identical replicated result
+    assert results[0]["accuracy"] == results[1]["accuracy"]

@@ -1,0 +1,100 @@
+"""Native C++ IDX reader / permutation tests: builds via g++ (skipped when
+no toolchain), asserts byte-identical parity with the Python parser and
+permutation validity/determinism."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.data import native
+
+
+requires_native = pytest.mark.skipif(
+    not native.ensure_built(), reason="g++ toolchain unavailable")
+
+
+def _write_idx(path, arr):
+    dims = arr.shape
+    header = struct.pack(f">I{len(dims)}I", 0x0800 | len(dims), *dims)
+    with open(path, "wb") as f:
+        f.write(header)
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+@requires_native
+def test_native_read_matches_python(tmp_path):
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 255, (50, 28, 28)).astype(np.uint8)
+    p = str(tmp_path / "images-idx3-ubyte")
+    _write_idx(p, arr)
+
+    got = native.read_idx(p)
+    np.testing.assert_array_equal(got, arr)
+
+    # parity with the Python parser through the public loader path
+    from distributedmnist_tpu.data.mnist import _read_idx
+    np.testing.assert_array_equal(_read_idx(p), arr)
+
+
+@requires_native
+def test_native_read_1d(tmp_path):
+    labels = np.arange(100, dtype=np.uint8) % 10
+    p = str(tmp_path / "labels-idx1-ubyte")
+    _write_idx(p, labels)
+    np.testing.assert_array_equal(native.read_idx(p), labels)
+
+
+@requires_native
+def test_native_rejects_bad_magic(tmp_path):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(b"\xde\xad\xbe\xef" + b"\x00" * 16)
+    with pytest.raises(ValueError, match="idx_probe"):
+        native.read_idx(p)
+
+
+@requires_native
+def test_gzip_still_uses_python_path(tmp_path):
+    """.gz must route to the Python parser (native reads raw only)."""
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 255, (10, 28, 28)).astype(np.uint8)
+    raw = str(tmp_path / "x-idx3-ubyte")
+    _write_idx(raw, arr)
+    gz = raw + ".gz"
+    with open(raw, "rb") as fin, gzip.open(gz, "wb") as fout:
+        fout.write(fin.read())
+    from distributedmnist_tpu.data.mnist import _read_idx
+    np.testing.assert_array_equal(_read_idx(gz), arr)
+
+
+@requires_native
+def test_native_epoch_perm_is_permutation():
+    p0 = native.epoch_perm(seed=7, epoch=0, n=1000)
+    assert sorted(p0.tolist()) == list(range(1000))
+    # deterministic per (seed, epoch), distinct across epochs/seeds
+    np.testing.assert_array_equal(p0, native.epoch_perm(7, 0, 1000))
+    assert not np.array_equal(p0, native.epoch_perm(7, 1, 1000))
+    assert not np.array_equal(p0, native.epoch_perm(8, 0, 1000))
+
+
+def test_available_never_compiles(tmp_path, monkeypatch):
+    """available() must not shell out to g++ — cold start stays fast."""
+    calls = []
+    monkeypatch.setattr(native.subprocess, "run",
+                        lambda *a, **k: calls.append(a))
+    native.available()
+    assert calls == []
+
+
+def test_python_fallback_when_lib_missing(monkeypatch, tmp_path):
+    """With the native path forced off, the loader still works."""
+    monkeypatch.setattr(native, "available", lambda: False)
+    rng = np.random.default_rng(2)
+    arr = rng.integers(0, 255, (5, 28, 28)).astype(np.uint8)
+    p = str(tmp_path / "y-idx3-ubyte")
+    _write_idx(p, arr)
+    from distributedmnist_tpu.data.mnist import _read_idx
+    np.testing.assert_array_equal(_read_idx(p), arr)
