@@ -39,7 +39,15 @@ class Config:
     device: str = "auto"            # {auto, tpu, cpu}
     num_devices: Optional[int] = None  # None = all visible devices
     spmd_mode: str = "auto"         # {auto: jit+shardings, explicit: shard_map+psum}
+    # tensor-parallel degree: folds devices into a ('data','model') mesh
+    # and shards the dense stacks Megatron-style (parallel/tp.py).
+    # Beyond-parity option; 1 = pure DP (the reference's strategy).
+    model_parallel: int = 1
     dtype: str = "float32"          # compute dtype {float32, bfloat16}
+    # steps fused into one XLA dispatch via lax.scan. MNIST steps are
+    # ~100µs on TPU, so per-dispatch host overhead dominates at 1; a
+    # scanned superstep amortizes it. None = auto (deep on TPU, 1 on CPU).
+    steps_per_call: Optional[int] = None
     # checkpointing
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 500     # steps between async saves
@@ -106,6 +114,8 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--device", choices=["auto", "tpu", "cpu"], default=None)
     p.add_argument("--num-devices", type=int, default=None)
     p.add_argument("--spmd-mode", choices=["auto", "explicit"], default=None)
+    p.add_argument("--steps-per-call", type=int, default=None)
+    p.add_argument("--model-parallel", type=int, default=None)
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default=None)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)

@@ -68,7 +68,6 @@ class IndexStream:
         self.global_batch = global_batch
         self.seed = seed
         self.mesh = mesh
-        self.sharding = NamedSharding(mesh, P("data"))
         self.steps_per_epoch = train_n // global_batch
         self.step = start_step
         self._perm_cache: tuple[int, np.ndarray] | None = None
@@ -91,11 +90,19 @@ class IndexStream:
     def __iter__(self) -> Iterator[jax.Array]:
         return self
 
-    def __next__(self) -> jax.Array:
+    def next_block(self, k: int) -> jax.Array:
+        """Indices for the next k steps as one (k, global_batch) array,
+        sharded P(None, 'data') — the K axis is scanned on device (one
+        dispatch per block), the batch axis is split across chips."""
         from distributedmnist_tpu.parallel import distributed
-        idx = self.indices_for_step(self.step).astype(np.int32)
-        self.step += 1
-        return distributed.global_batch_indices(idx, self.mesh)
+        idx = np.stack([self.indices_for_step(self.step + i)
+                        for i in range(k)]).astype(np.int32)
+        self.step += k
+        return distributed.put_global(
+            idx, NamedSharding(self.mesh, P(None, "data")))
+
+    def __next__(self) -> jax.Array:
+        return self.next_block(1)
 
 
 def eval_batches(test_n: int, batch: int) -> tuple[np.ndarray, np.ndarray]:

@@ -35,6 +35,8 @@ def ensure_built(force: bool = False) -> bool:
     """Compile the library if missing/stale. Atomic (temp file + rename) so
     concurrent builders in different processes can race harmlessly — each
     renames a complete .so into place. Returns availability."""
+    if not os.path.exists(_SRC):
+        return available()  # shipped .so without source: use as-is
     stale = (not os.path.exists(_LIB)
              or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
     if stale or force:

@@ -5,3 +5,4 @@ from distributedmnist_tpu.parallel.mesh import (  # noqa: F401
     batch_sharded,
 )
 from distributedmnist_tpu.parallel import distributed  # noqa: F401
+from distributedmnist_tpu.parallel import tp  # noqa: F401

@@ -74,9 +74,3 @@ def put_replicated(arr: np.ndarray, mesh: Mesh) -> jax.Array:
     return put_global(arr, NamedSharding(mesh, P()))
 
 
-def global_batch_indices(idx: np.ndarray, mesh: Mesh) -> jax.Array:
-    """Sharded global index array for one step. Every process computed the
-    same global `idx` (seeded stream); each device receives its 'data' slice
-    — the multi-host replacement for the reference's shard-by-rank
-    DataLoader [BASELINE.json north_star]."""
-    return put_global(idx, NamedSharding(mesh, P(DATA_AXIS)))

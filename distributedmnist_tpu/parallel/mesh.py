@@ -48,8 +48,18 @@ def get_devices(device: str = "auto",
     return devs
 
 
-def make_mesh(devices: Sequence) -> Mesh:
-    return Mesh(np.asarray(devices), (DATA_AXIS,))
+def make_mesh(devices: Sequence, model_parallel: int = 1) -> Mesh:
+    """1-D ('data',) mesh by default — DP is the reference's only strategy.
+    model_parallel > 1 folds the devices into a 2-D ('data', 'model') mesh
+    for the optional tensor-parallel placement (parallel/tp.py)."""
+    devices = np.asarray(devices)
+    if model_parallel <= 1:
+        return Mesh(devices, (DATA_AXIS,))
+    if devices.size % model_parallel:
+        raise ValueError(
+            f"{devices.size} devices not divisible by "
+            f"model_parallel={model_parallel}")
+    return Mesh(devices.reshape(-1, model_parallel), (DATA_AXIS, "model"))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,83 @@
+"""Optional tensor parallelism over a 2-D ('data', 'model') mesh.
+
+The reference has data parallelism only (SURVEY.md §2: "DP — the only
+one"), so this is a beyond-parity capability, not a port: it exists to show
+the mesh design generalizes past DP the TPU way. There is NO new step
+function — the same jitted train step runs unchanged; tensor parallelism is
+purely a change of parameter PLACEMENT (Megatron-style paired specs below),
+and XLA's sharding propagation inserts the column/row-parallel collectives.
+
+Pairing (for each dense pair A @ B):
+  first kernel  P(None, 'model')   column-parallel: activations sharded
+  its bias      P('model')
+  second kernel P('model', None)   row-parallel: psum on the way out
+Conv kernels and everything else stay replicated — at LeNet scale convs
+have no use for TP; the dense tail is where the parameters are.
+
+Optimizer state (adam mu/nu) mirrors the params tree, and the name-based
+rules match on path components, so mu/nu leaves pick up the identical specs
+for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+
+
+def _mlp_rule(names: set, ndim: int) -> P:
+    # XLA path: params['hidden']['kernel'|'bias']; Pallas path names them
+    # hidden_kernel / hidden_bias at the top level.
+    if "hidden" in names or "hidden_kernel" in names or "hidden_bias" in names:
+        return P(None, MODEL_AXIS) if ndim == 2 else P(MODEL_AXIS)
+    if "logits" in names and ndim == 2:
+        return P(MODEL_AXIS, None)
+    return P()
+
+
+def _lenet_rule(names: set, ndim: int) -> P:
+    if "fc1" in names:
+        return P(None, MODEL_AXIS) if ndim == 2 else P(MODEL_AXIS)
+    if "fc2" in names and ndim == 2:
+        return P(MODEL_AXIS, None)
+    return P()
+
+
+_RULES = {"mlp": _mlp_rule, "lenet": _lenet_rule}
+
+
+def _path_names(path) -> set:
+    names = set()
+    for p in path:
+        for attr in ("key", "name"):
+            v = getattr(p, attr, None)
+            if isinstance(v, str):
+                names.add(v)
+    return names
+
+
+def state_shardings(state: Any, mesh: Mesh, model_name: str):
+    """NamedSharding pytree for a TrainState under the given mesh.
+
+    1-D mesh (no 'model' axis): everything replicated — the DP baseline.
+    2-D mesh: the model's rules decide; any leaf whose sharded dim would
+    not divide evenly falls back to replicated.
+    """
+    if MODEL_AXIS not in mesh.axis_names:
+        return jax.tree.map(lambda _: NamedSharding(mesh, P()), state)
+    rule = _RULES[model_name]
+    mp = mesh.shape[MODEL_AXIS]
+
+    def leaf(path, x):
+        spec = rule(_path_names(path), len(getattr(x, "shape", ())))
+        for dim, axis in enumerate(spec):
+            if axis == MODEL_AXIS and x.shape[dim] % mp:
+                spec = P()  # not divisible: replicate rather than fail
+                break
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf, state)

@@ -41,8 +41,9 @@ from distributedmnist_tpu.config import Config
 from distributedmnist_tpu.data import DeviceDataset, IndexStream, load_mnist
 from distributedmnist_tpu.data.loader import eval_batches
 from distributedmnist_tpu.ops import accuracy_count, cross_entropy
-from distributedmnist_tpu.parallel import distributed, get_devices, make_mesh
-from distributedmnist_tpu.parallel.mesh import DATA_AXIS, replicated
+from distributedmnist_tpu.parallel import (
+    distributed, get_devices, make_mesh, tp)
+from distributedmnist_tpu.parallel.mesh import DATA_AXIS
 from distributedmnist_tpu.utils import MetricsLogger, StepTimer, round_up
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
@@ -80,51 +81,67 @@ def _forward_loss(model, dtype):
 
 def make_train_step(model, tx, mesh, mode: str = "auto",
                     dtype=jnp.float32):
-    """Build the jitted train step: (state, train_x, train_y, idx) ->
-    (state, metrics). `idx` is the global-batch index array sharded over
-    'data'; the dataset arrays are replicated."""
+    """Build the jitted train step: (state, train_x, train_y, idx_block) ->
+    (state, metrics).
+
+    `idx_block` has shape (K, global_batch) — K optimizer steps fused into
+    ONE XLA dispatch via `lax.scan` (the TPU superstep: a single MNIST step
+    is ~100µs, so at K=1 host dispatch dominates wall-clock; scanning K
+    steps amortizes it K-fold). The leading K axis is scanned; the batch
+    axis is sharded over 'data'. The dataset arrays are replicated.
+    metrics = {"loss": last-step loss, "loss_mean": mean over the block}.
+    """
     loss_fn = _forward_loss(model, dtype)
+
+    def _one_step(state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state), loss
 
     if mode == "auto":
         batch_spec = NamedSharding(mesh, P(DATA_AXIS))
 
-        def _step(state, train_x, train_y, idx):
-            x = jax.lax.with_sharding_constraint(
-                jnp.take(train_x, idx, axis=0), batch_spec)
-            y = jax.lax.with_sharding_constraint(
-                jnp.take(train_y, idx, axis=0), batch_spec)
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
-            updates, opt_state = tx.update(grads, state.opt_state,
-                                           state.params)
-            params = optax.apply_updates(state.params, updates)
-            new = TrainState(step=state.step + 1, params=params,
-                             opt_state=opt_state)
-            return new, {"loss": loss}
+        def _block(state, train_x, train_y, idx_block):
+            def body(state, idx):
+                x = jax.lax.with_sharding_constraint(
+                    jnp.take(train_x, idx, axis=0), batch_spec)
+                y = jax.lax.with_sharding_constraint(
+                    jnp.take(train_y, idx, axis=0), batch_spec)
+                return _one_step(state, x, y)
 
-        return jax.jit(_step, donate_argnums=0)
+            state, losses = jax.lax.scan(body, state, idx_block)
+            return state, {"loss": losses[-1], "loss_mean": losses.mean()}
+
+        return jax.jit(_block, donate_argnums=0)
 
     if mode != "explicit":
         raise ValueError(f"unknown spmd mode {mode!r}")
 
     # explicit: the reference's per-step gradient allreduce, spelled out as
     # lax.pmean over the named 'data' axis inside shard_map [north_star].
-    def _local_step(state, train_x, train_y, idx):
-        x = jnp.take(train_x, idx, axis=0)   # idx is the LOCAL shard here
-        y = jnp.take(train_y, idx, axis=0)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
-        # Equal shard sizes (enforced at config time) make pmean-of-means
-        # the exact global mean.
-        grads = jax.lax.pmean(grads, DATA_AXIS)
-        loss = jax.lax.pmean(loss, DATA_AXIS)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        new = TrainState(step=state.step + 1, params=params,
-                         opt_state=opt_state)
-        return new, {"loss": loss}
+    def _local_block(state, train_x, train_y, idx_block):
+        def body(state, idx):             # idx is the LOCAL shard here
+            x = jnp.take(train_x, idx, axis=0)
+            y = jnp.take(train_y, idx, axis=0)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
+            # Equal shard sizes (enforced at config time) make
+            # pmean-of-means the exact global mean.
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(step=state.step + 1, params=params,
+                              opt_state=opt_state), loss
+
+        state, losses = jax.lax.scan(body, state, idx_block)
+        return state, {"loss": losses[-1], "loss_mean": losses.mean()}
 
     smapped = shard_map(
-        _local_step, mesh=mesh,
-        in_specs=(P(), P(), P(), P(DATA_AXIS)),
+        _local_block, mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, DATA_AXIS)),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -153,6 +170,26 @@ def make_eval_fn(model, mesh, dtype=jnp.float32):
     return jax.jit(_eval)
 
 
+def _pick_steps_per_call(cfg: Config, platform: str, has_ckpt: bool) -> int:
+    """Steps fused per XLA dispatch. Auto: 1 on CPU (synchronous, small
+    thread pool); on TPU the largest k <= 64 dividing the eval/checkpoint
+    cadence, so block edges land exactly on eval and checkpoint steps."""
+    if cfg.steps_per_call is not None:
+        return max(1, cfg.steps_per_call)
+    if platform == "cpu":
+        return 1
+    import math
+    cadence = cfg.eval_every
+    if has_ckpt:
+        cadence = math.gcd(cadence, cfg.checkpoint_every)
+    if cfg.fail_at_step:
+        cadence = math.gcd(cadence, cfg.fail_at_step)
+    for k in range(min(64, cadence), 0, -1):
+        if cadence % k == 0:
+            return k
+    return 1
+
+
 def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     """Run one training workload end-to-end; returns the summary dict whose
     JSON form is the driver-facing result (SURVEY.md §2 row 11)."""
@@ -162,23 +199,37 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         cfg.coordinator_address, cfg.num_processes, cfg.process_id)
     devices = get_devices(cfg.device, cfg.num_devices)
     n_chips = len(devices)
-    if cfg.batch_size % n_chips:
+    mp = cfg.model_parallel
+    if mp > 1 and cfg.spmd_mode == "explicit":
+        raise ValueError("model_parallel > 1 requires spmd_mode=auto "
+                         "(the explicit shard_map path is DP-only)")
+    if n_chips % mp:
         raise ValueError(
-            f"global batch {cfg.batch_size} not divisible by {n_chips} chips")
-    mesh = make_mesh(devices)
+            f"{n_chips} chips not divisible by model_parallel={mp}")
+    dp_size = n_chips // mp
+    if cfg.batch_size % dp_size:
+        raise ValueError(
+            f"global batch {cfg.batch_size} not divisible by "
+            f"{dp_size} data-parallel chips")
+    mesh = make_mesh(devices, mp)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
     data = data if data is not None else load_mnist(
         cfg.data_dir, cfg.synthetic, cfg.seed)
     ds = DeviceDataset(data, mesh)
 
-    model = models.build(cfg.model, dtype=dtype, fused=cfg.fused_kernels,
+    # TP shards whole params across 'model'; the Pallas kernel is written
+    # for unsharded operands, so TP runs force the XLA dense path.
+    fused = "xla" if mp > 1 else cfg.fused_kernels
+    model = models.build(cfg.model, dtype=dtype, fused=fused,
                          platform=devices[0].platform)
     tx = optim.build(cfg.optimizer, cfg.learning_rate, cfg.momentum)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
-    state = jax.device_put(init_state(rng, model, tx, sample),
-                           replicated(mesh))
+    state = init_state(rng, model, tx, sample)
+    # Placement IS the parallelism: replicated under pure DP, Megatron-style
+    # specs under TP (parallel/tp.py); the step function never changes.
+    state = jax.device_put(state, tp.state_shardings(state, mesh, cfg.model))
 
     ckpt = None
     restored = False
@@ -226,7 +277,7 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     inflight: deque = deque()
 
     timer = StepTimer(cfg.batch_size, n_chips)
-    mlog = MetricsLogger(cfg.log_every)
+    mlog = MetricsLogger()
     t_start = time.perf_counter()
     accuracy = 0.0
     reached_target_at: Optional[float] = None
@@ -235,39 +286,51 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         jax.profiler.start_trace(cfg.profile_dir)
         profiling = True
 
+    spc = _pick_steps_per_call(cfg, devices[0].platform, bool(ckpt))
+
+    def crossed(step_before: int, step_after: int, every: int) -> bool:
+        return step_after // every > step_before // every
+
     step = start_step
+    first_call = True
     try:
-        for step in range(start_step, total_steps):
-            idx = next(stream)
+        while step < total_steps:
+            k = min(spc, total_steps - step)  # remainder block recompiles
+            idx_block = stream.next_block(k)  # once; only at the very end
             # Block BEFORE dispatching so at most max_inflight programs are
             # ever concurrently in flight (cap 1 on CPU really means 1).
             while len(inflight) >= max_inflight:
                 jax.block_until_ready(inflight.popleft())
-            state, metrics = step_fn(state, ds.train_x, ds.train_y, idx)
+            state, metrics = step_fn(state, ds.train_x, ds.train_y,
+                                     idx_block)
             inflight.append(metrics["loss"])
-            if step == start_step:
+            prev, step = step, step + k
+            if first_call:
                 timer.start(sync=metrics["loss"])  # excludes compile time
+                first_call = False
             else:
-                timer.lap()
-            mlog.step(step, {"loss": metrics["loss"]})
+                timer.lap(k)
+            if cfg.log_every and crossed(prev, step, cfg.log_every):
+                mlog.step(step, {"loss": metrics["loss"],
+                                 "loss_mean": metrics["loss_mean"]})
 
-            if ckpt and (step + 1) % cfg.checkpoint_every == 0:
+            if ckpt and crossed(prev, step, cfg.checkpoint_every):
                 with timer.exclude():
-                    ckpt.save(step + 1, state)  # async; overlaps next steps
+                    ckpt.save(step, state)  # async; overlaps next steps
 
-            if cfg.fail_at_step is not None and step + 1 >= cfg.fail_at_step:
+            if cfg.fail_at_step is not None and step >= cfg.fail_at_step:
                 if ckpt:
                     ckpt.wait()
-                raise SimulatedFailure(f"injected failure at step {step + 1}")
+                raise SimulatedFailure(f"injected failure at step {step}")
 
-            if (step + 1) % cfg.eval_every == 0 or step + 1 == total_steps:
+            if crossed(prev, step, cfg.eval_every) or step == total_steps:
                 accuracy = evaluate(state)
-                mlog.eval(step + 1, accuracy)
+                mlog.eval(step, accuracy)
                 if (cfg.target_accuracy is not None
                         and accuracy >= cfg.target_accuracy):
                     reached_target_at = time.perf_counter() - t_start
                     log.info("target accuracy %.3f reached at step %d "
-                             "(%.2fs)", cfg.target_accuracy, step + 1,
+                             "(%.2fs)", cfg.target_accuracy, step,
                              reached_target_at)
                     break
     finally:
@@ -289,6 +352,7 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         "optimizer": cfg.optimizer,
         "spmd_mode": cfg.spmd_mode,
         "n_chips": n_chips,
+        "model_parallel": mp,
         "n_processes": jax.process_count(),
         "multihost": multihost,
         "global_batch": cfg.batch_size,

@@ -42,8 +42,8 @@ class StepTimer:
         self.steps = 0
         self.excluded = 0.0
 
-    def lap(self) -> None:
-        self.steps += 1
+    def lap(self, n: int = 1) -> None:
+        self.steps += n
 
     @contextlib.contextmanager
     def exclude(self):
@@ -72,20 +72,23 @@ class StepTimer:
 
 
 class MetricsLogger:
-    """Per-step scalar log + final JSON line for the driver harness."""
+    """Per-step scalar log + final JSON line for the driver harness.
+    Cadence gating is the caller's responsibility (the trainer gates on
+    block-crossing); every step()/eval() call is recorded."""
 
-    def __init__(self, log_every: int = 100):
-        self.log_every = log_every
+    def __init__(self):
         self.history: list[dict] = []
 
     def step(self, step: int, scalars: dict) -> None:
-        if self.log_every and step % self.log_every == 0:
-            rec = {"step": step}
-            rec.update({k: float(v) for k, v in scalars.items()})
-            self.history.append(rec)
-            log.info("step %6d  %s", step,
-                     "  ".join(f"{k}={v:.4g}" for k, v in rec.items()
-                               if k != "step"))
+        """Record + log one step's scalars. Cadence is the caller's job
+        (the trainer gates on block-crossing); calling this forces a device
+        sync via float(), so don't call it every step on TPU."""
+        rec = {"step": step}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self.history.append(rec)
+        log.info("step %6d  %s", step,
+                 "  ".join(f"{k}={v:.4g}" for k, v in rec.items()
+                           if k != "step"))
 
     def eval(self, step: int, accuracy: float) -> None:
         log.info("eval step %6d  test_accuracy=%.4f", step, accuracy)

@@ -111,10 +111,14 @@ def test_index_stream_sharded_batch(eight_devices):
     mesh = make_mesh(eight_devices)
     stream = IndexStream(2048, 256, seed=0, mesh=mesh)
     idx = next(stream)
-    assert idx.shape == (256,)
-    # sharded over 'data': each device holds 256/8 rows
-    shard_rows = {s.data.shape[0] for s in idx.addressable_shards}
-    assert shard_rows == {32}
+    assert idx.shape == (1, 256)  # (steps_per_call, global_batch)
+    # batch axis sharded over 'data': each device holds 256/8 columns
+    shard_cols = {s.data.shape[1] for s in idx.addressable_shards}
+    assert shard_cols == {32}
+    # block of 4 scanned steps advances the stream by 4
+    blk = stream.next_block(4)
+    assert blk.shape == (4, 256)
+    assert stream.step == 5
 
 
 def test_device_dataset_replicated(tiny_data, eight_devices):
