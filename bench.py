@@ -1,17 +1,23 @@
 #!/usr/bin/env python
-"""Benchmark: steady-state training throughput, printed as ONE JSON line.
+"""Benchmark, printed as ONE JSON line. Two modes for the two halves of
+the BASELINE metric ("MNIST images/sec/chip; wall-clock to 99% test
+accuracy"):
 
-Metric: images/sec/chip on the LeNet-5 data-parallel workload
-[BASELINE.json metric: "MNIST images/sec/chip"; config 4: global batch 512].
-The full fused step (fwd+bwd+allreduce+update, on-device batch gather) is
-timed after a compile/warmup phase, on every visible device of the default
-backend (the real TPU chip under the driver).
+- throughput (default): steady-state training images/sec/chip on the
+  LeNet-5 data-parallel workload [config 4: global batch 512]. The full
+  fused step (fwd+bwd+allreduce+update, on-device batch gather) is timed
+  after a compile/warmup phase, on every visible device of the default
+  backend (the real TPU chip under the driver).
+- time-to-accuracy: wall-clock seconds for a full training run to reach
+  --target-accuracy (train + eval, compile excluded from neither — this is
+  the end-to-end number a user experiences).
 
 vs_baseline: the reference publishes no numbers (BASELINE.md — empty mount,
 published={}); the only quantitative anchor is the driver's north-star
-target "≥99% in <30s on a v4-8 with near-linear scaling", which implies
-roughly 10 epochs * 60k images / 30s / 8 chips = 2500 images/sec/chip.
-vs_baseline is value / 2500 — i.e. >1.0 means faster than the target rate.
+target ">=99% in <30s on a v4-8 with near-linear scaling". For throughput
+that implies roughly 10 epochs * 60k images / 30s / 8 chips = 2500
+images/sec/chip and vs_baseline = value / 2500; for time-to-accuracy
+vs_baseline = 30 / value. Either way >1.0 beats the target.
 """
 
 from __future__ import annotations
@@ -22,20 +28,38 @@ import sys
 import time
 
 TARGET_IPS_PER_CHIP = 2500.0
+TARGET_WALL_S = 30.0
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["throughput", "time-to-accuracy"],
+                   default="throughput")
+    p.add_argument("--target-accuracy", type=float, default=0.99)
+    p.add_argument("--data-dir", default=None,
+                   help="real MNIST IDX/npz dir; synthetic fallback")
+    p.add_argument("--max-epochs", type=int, default=20)
     p.add_argument("--global-batch", type=int, default=512)
-    p.add_argument("--warmup-steps", type=int, default=20)
-    p.add_argument("--bench-steps", type=int, default=200,
-                   help="must be >= 1")
+    p.add_argument("--warmup-steps", type=int, default=None,
+                   help="[throughput] compile/warmup steps (default 20)")
+    p.add_argument("--bench-steps", type=int, default=None,
+                   help="[throughput] timed steps, >= 1 (default 200)")
     p.add_argument("--steps-per-call", type=int, default=None,
                    help="optimizer steps fused per dispatch via lax.scan "
                         "(default: 1 on cpu, 32 on tpu)")
     p.add_argument("--model", default="lenet")
     p.add_argument("--dtype", default="float32")
     args = p.parse_args(argv)
+    if args.mode == "time-to-accuracy":
+        # throughput-only knobs are rejected, not silently ignored
+        # (--warmup-steps especially would read as LR warmup here)
+        if args.warmup_steps is not None or args.bench_steps is not None:
+            p.error("--warmup-steps/--bench-steps are throughput-mode "
+                    "flags; time-to-accuracy takes --max-epochs and "
+                    "--steps-per-call")
+        return _time_to_accuracy(args)
+    args.warmup_steps = 20 if args.warmup_steps is None else args.warmup_steps
+    args.bench_steps = 200 if args.bench_steps is None else args.bench_steps
     if args.bench_steps < 1:
         p.error("--bench-steps must be >= 1")
 
@@ -110,6 +134,51 @@ def main(argv=None) -> int:
             "bench_steps": n_run,
             "steps_per_call": spc,
             "step_ms": round(1000 * elapsed / n_run, 3),
+        },
+    }))
+    return 0
+
+
+def _time_to_accuracy(args) -> int:
+    import jax
+
+    from distributedmnist_tpu import trainer
+    from distributedmnist_tpu.config import Config
+
+    n_chips = len(jax.devices())
+    cfg = Config(model=args.model, optimizer="adam", learning_rate=2e-3,
+                 lr_schedule="cosine",
+                 data_dir=args.data_dir, synthetic=args.data_dir is None,
+                 batch_size=args.global_batch,
+                 epochs=args.max_epochs,
+                 eval_every=100, log_every=0,
+                 target_accuracy=args.target_accuracy,
+                 steps_per_call=args.steps_per_call,
+                 dtype=args.dtype)
+    t0 = time.perf_counter()
+    out = trainer.fit(cfg)
+    wall = out["wall_clock_to_target_s"]
+    reached = wall is not None
+    value = wall if reached else time.perf_counter() - t0
+    # vs_baseline only counts when the accuracy half of the target was met;
+    # a fast run that never reached target is a miss (0.0), not a win.
+    vs = round(TARGET_WALL_S / value, 3) if (reached and value) else 0.0
+    print(json.dumps({
+        "metric": "wall_clock_to_target_accuracy",
+        "value": round(value, 2),
+        "unit": "seconds",
+        "vs_baseline": vs,
+        "detail": {
+            "reached_target": reached,
+            "target_accuracy": args.target_accuracy,
+            "final_accuracy": round(out["test_accuracy"], 4),
+            "steps": out["steps"],
+            "data": out["data"],
+            "model": args.model,
+            "global_batch": out["global_batch"],
+            "n_chips": n_chips,
+            "backend": jax.devices()[0].platform,
+            "dtype": args.dtype,
         },
     }))
     return 0

@@ -25,10 +25,16 @@ class Config:
     optimizer: str = "adam"         # {sgd, adam}
     learning_rate: float = 1e-3
     momentum: float = 0.9           # used by sgd only
+    lr_schedule: str = "constant"   # {constant, cosine, warmup-cosine}
+    warmup_steps: int = 0
     # data
     data_dir: Optional[str] = None  # dir with IDX (*-ubyte[.gz]) or mnist.npz
     synthetic: bool = False         # force deterministic synthetic MNIST
     batch_size: int = 512           # GLOBAL batch size (split across chips)
+    # "device": whole train set HBM-resident, on-device index gather (the
+    # MNIST-optimal default). "stream": per-host streaming batches for
+    # datasets that outgrow HBM (data/host_loader.py). Same batch order.
+    data_pipeline: str = "device"
     # schedule
     epochs: int = 10
     steps: Optional[int] = None     # overrides epochs when set
@@ -103,8 +109,14 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--optimizer", choices=["sgd", "adam"], default=None)
     p.add_argument("--learning-rate", type=float, default=None)
     p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--lr-schedule",
+                   choices=["constant", "cosine", "warmup-cosine"],
+                   default=None)
+    p.add_argument("--warmup-steps", type=int, default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--synthetic", action="store_true", default=None)
+    p.add_argument("--data-pipeline", choices=["device", "stream"],
+                   default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--steps", type=int, default=None)

@@ -1,0 +1,63 @@
+"""Streaming host->device input pipeline — the per-host loader for datasets
+too large to live device-resident.
+
+The default pipeline (loader.DeviceDataset + IndexStream) keeps the whole
+dataset in HBM and moves only indices — optimal at MNIST scale. This module
+is the general form the reference's shard-by-rank DataLoader takes when the
+dataset outgrows HBM [BASELINE.json north_star: "per-host tf.data pipeline
+feeding device-sharded global batches"]: the host materializes each step's
+global batch rows and places them already sharded over 'data', so each
+device receives exactly its 1/n slice (per-process slices in multi-host via
+parallel.distributed.put_global — no cross-host data movement).
+
+Batch order is IDENTICAL to the device-resident pipeline (same seeded
+epoch permutations via IndexStream's index math), so the two pipelines are
+interchangeable mid-training and equivalence-tested against each other.
+jax async dispatch overlaps the host gather/transfer of block k+1 with the
+device compute of block k.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributedmnist_tpu.data.loader import IndexStream
+
+
+class HostStream:
+    """Yields (x_block, y_block) device arrays of shape (K, B, ...) with
+    the batch axis sharded over 'data'."""
+
+    def __init__(self, train_x: np.ndarray, train_y: np.ndarray,
+                 global_batch: int, seed: int, mesh: Mesh,
+                 start_step: int = 0):
+        self.train_x = train_x
+        self.train_y = train_y
+        self.mesh = mesh
+        # Reuse IndexStream's seeded epoch-permutation math so batch order
+        # matches the device-resident pipeline exactly.
+        self.indices = IndexStream(train_x.shape[0], global_batch, seed,
+                                   mesh, start_step=start_step)
+
+    @property
+    def step(self) -> int:
+        return self.indices.step
+
+    def next_block(self, k: int):
+        import jax
+        idx = np.stack([self.indices.indices_for_step(self.indices.step + i)
+                        for i in range(k)])
+        self.indices.step += k
+
+        def put(arr):
+            # Per-device callback: each device (and therefore each process)
+            # gathers ONLY the rows of its own 'data' slice — no process
+            # ever materializes the full global batch on the host, which is
+            # the point of the streaming pipeline at multi-host scale.
+            shape = idx.shape + arr.shape[1:]
+            sharding = NamedSharding(self.mesh, P(None, "data"))
+            return jax.make_array_from_callback(
+                shape, sharding, lambda s: arr[idx[s[0], s[1]]])
+
+        return put(self.train_x), put(self.train_y)

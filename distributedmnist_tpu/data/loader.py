@@ -35,12 +35,19 @@ class DeviceDataset:
     over 'data' because the indices are.
     """
 
-    def __init__(self, data: dict, mesh: Mesh):
+    def __init__(self, data: dict, mesh: Mesh,
+                 device_resident_train: bool = True):
         from distributedmnist_tpu.parallel import distributed
         self.mesh = mesh
         self.source = data.get("source", "unknown")
-        self.train_x = distributed.put_replicated(data["train_x"], mesh)
-        self.train_y = distributed.put_replicated(data["train_y"], mesh)
+        # The streaming pipeline (host_loader.py) keeps train data on the
+        # host; only the (small) test set goes to HBM then.
+        if device_resident_train:
+            self.train_x = distributed.put_replicated(data["train_x"], mesh)
+            self.train_y = distributed.put_replicated(data["train_y"], mesh)
+        else:
+            self.train_x = None
+            self.train_y = None
         self.test_x = distributed.put_replicated(data["test_x"], mesh)
         self.test_y = distributed.put_replicated(data["test_y"], mesh)
         self.train_n = int(data["train_x"].shape[0])

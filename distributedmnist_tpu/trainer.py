@@ -79,6 +79,17 @@ def _forward_loss(model, dtype):
     return loss_fn
 
 
+def _make_one_step(loss_fn, tx):
+    """grad -> optimizer update -> new state, for one (x, y) batch."""
+    def one_step(state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state), loss
+    return one_step
+
+
 def make_train_step(model, tx, mesh, mode: str = "auto",
                     dtype=jnp.float32):
     """Build the jitted train step: (state, train_x, train_y, idx_block) ->
@@ -92,13 +103,7 @@ def make_train_step(model, tx, mesh, mode: str = "auto",
     metrics = {"loss": last-step loss, "loss_mean": mean over the block}.
     """
     loss_fn = _forward_loss(model, dtype)
-
-    def _one_step(state, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(step=state.step + 1, params=params,
-                          opt_state=opt_state), loss
+    one_step = _make_one_step(loss_fn, tx)
 
     if mode == "auto":
         batch_spec = NamedSharding(mesh, P(DATA_AXIS))
@@ -109,7 +114,7 @@ def make_train_step(model, tx, mesh, mode: str = "auto",
                     jnp.take(train_x, idx, axis=0), batch_spec)
                 y = jax.lax.with_sharding_constraint(
                     jnp.take(train_y, idx, axis=0), batch_spec)
-                return _one_step(state, x, y)
+                return one_step(state, x, y)
 
             state, losses = jax.lax.scan(body, state, idx_block)
             return state, {"loss": losses[-1], "loss_mean": losses.mean()}
@@ -118,7 +123,32 @@ def make_train_step(model, tx, mesh, mode: str = "auto",
 
     if mode != "explicit":
         raise ValueError(f"unknown spmd mode {mode!r}")
+    return _make_explicit_step(loss_fn, tx, mesh)
 
+
+def make_train_step_from_batches(model, tx, mesh, dtype=jnp.float32):
+    """Train step consuming pre-gathered batches from the streaming host
+    pipeline (data/host_loader.HostStream): (state, x_block, y_block) ->
+    (state, metrics), x_block (K, B, 28, 28, 1) sharded P(None, 'data').
+    Used when the dataset can't live device-resident; the scan/metrics
+    semantics match make_train_step exactly."""
+    one_step = _make_one_step(_forward_loss(model, dtype), tx)
+    batch_spec = NamedSharding(mesh, P(DATA_AXIS))
+
+    def _block(state, x_block, y_block):
+        def body(state, xy):
+            x, y = xy
+            x = jax.lax.with_sharding_constraint(x, batch_spec)
+            y = jax.lax.with_sharding_constraint(y, batch_spec)
+            return one_step(state, x, y)
+
+        state, losses = jax.lax.scan(body, state, (x_block, y_block))
+        return state, {"loss": losses[-1], "loss_mean": losses.mean()}
+
+    return jax.jit(_block, donate_argnums=0)
+
+
+def _make_explicit_step(loss_fn, tx, mesh):
     # explicit: the reference's per-step gradient allreduce, spelled out as
     # lax.pmean over the named 'data' axis inside shard_map [north_star].
     def _local_block(state, train_x, train_y, idx_block):
@@ -214,16 +244,26 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     mesh = make_mesh(devices, mp)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
+    if cfg.data_pipeline not in ("device", "stream"):
+        raise ValueError(f"unknown data pipeline {cfg.data_pipeline!r}")
+    streaming = cfg.data_pipeline == "stream"
+    if streaming and cfg.spmd_mode == "explicit":
+        raise ValueError("data_pipeline=stream requires spmd_mode=auto")
     data = data if data is not None else load_mnist(
         cfg.data_dir, cfg.synthetic, cfg.seed)
-    ds = DeviceDataset(data, mesh)
+    ds = DeviceDataset(data, mesh, device_resident_train=not streaming)
 
     # TP shards whole params across 'model'; the Pallas kernel is written
     # for unsharded operands, so TP runs force the XLA dense path.
     fused = "xla" if mp > 1 else cfg.fused_kernels
     model = models.build(cfg.model, dtype=dtype, fused=fused,
                          platform=devices[0].platform)
-    tx = optim.build(cfg.optimizer, cfg.learning_rate, cfg.momentum)
+    steps_per_epoch = ds.train_n // cfg.batch_size
+    total_steps = cfg.steps if cfg.steps is not None \
+        else cfg.epochs * steps_per_epoch
+    lr = optim.make_schedule(cfg.learning_rate, cfg.lr_schedule,
+                             cfg.warmup_steps, total_steps)
+    tx = optim.build(cfg.optimizer, lr, cfg.momentum)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
     state = init_state(rng, model, tx, sample)
@@ -241,13 +281,23 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
                 log.info("restored checkpoint at step %d", int(state.step))
 
     start_step = int(state.step)
-    steps_per_epoch = ds.train_n // cfg.batch_size
-    total_steps = cfg.steps if cfg.steps is not None \
-        else cfg.epochs * steps_per_epoch
-    stream = IndexStream(ds.train_n, cfg.batch_size, cfg.seed, mesh,
-                         start_step=start_step)
+    if streaming:
+        from distributedmnist_tpu.data.host_loader import HostStream
+        stream = HostStream(data["train_x"], data["train_y"],
+                            cfg.batch_size, cfg.seed, mesh,
+                            start_step=start_step)
+        step_fn = make_train_step_from_batches(model, tx, mesh, dtype)
 
-    step_fn = make_train_step(model, tx, mesh, cfg.spmd_mode, dtype)
+        def run_block(state, k):
+            return step_fn(state, *stream.next_block(k))
+    else:
+        stream = IndexStream(ds.train_n, cfg.batch_size, cfg.seed, mesh,
+                             start_step=start_step)
+        step_fn = make_train_step(model, tx, mesh, cfg.spmd_mode, dtype)
+
+        def run_block(state, k):
+            return step_fn(state, ds.train_x, ds.train_y,
+                           stream.next_block(k))
     eval_fn = make_eval_fn(model, mesh, dtype)
     eb = round_up(min(2048, ds.test_n), n_chips)
     idx_mat, mask_mat = eval_batches(ds.test_n, eb)
@@ -296,13 +346,12 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     try:
         while step < total_steps:
             k = min(spc, total_steps - step)  # remainder block recompiles
-            idx_block = stream.next_block(k)  # once; only at the very end
+                                              # once; only at the very end
             # Block BEFORE dispatching so at most max_inflight programs are
             # ever concurrently in flight (cap 1 on CPU really means 1).
             while len(inflight) >= max_inflight:
                 jax.block_until_ready(inflight.popleft())
-            state, metrics = step_fn(state, ds.train_x, ds.train_y,
-                                     idx_block)
+            state, metrics = run_block(state, k)
             inflight.append(metrics["loss"])
             prev, step = step, step + k
             if first_call:
@@ -357,6 +406,7 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         "multihost": multihost,
         "global_batch": cfg.batch_size,
         "data": ds.source,
+        "data_pipeline": cfg.data_pipeline,
         "steps": int(state.step),
         "restored": restored,
         "test_accuracy": accuracy,

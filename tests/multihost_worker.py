@@ -23,13 +23,22 @@ def main() -> int:
     from distributedmnist_tpu.config import Config
     from distributedmnist_tpu.data import synthetic_mnist
 
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    fail_at = int(sys.argv[5]) if len(sys.argv) > 5 else None
+
     data = synthetic_mnist(seed=1, train_n=1024, test_n=256)
     cfg = Config(model="mlp", optimizer="sgd", learning_rate=0.02,
                  batch_size=64, steps=6, eval_every=6, device="cpu",
                  synthetic=True, log_every=0, target_accuracy=None,
                  coordinator_address=f"localhost:{port}",
-                 num_processes=num_processes, process_id=process_id)
-    out = trainer.fit(cfg, data=data)
+                 num_processes=num_processes, process_id=process_id,
+                 checkpoint_dir=ckpt_dir, checkpoint_every=3,
+                 fail_at_step=fail_at)
+    try:
+        out = trainer.fit(cfg, data=data)
+    except trainer.SimulatedFailure:
+        print("MHFAILED injected", flush=True)
+        return 0
     print("MHRESULT " + json.dumps({
         "process_id": process_id,
         "steps": out["steps"],
@@ -37,6 +46,7 @@ def main() -> int:
         "n_chips": out["n_chips"],
         "n_processes": out["n_processes"],
         "multihost": out["multihost"],
+        "restored": out["restored"],
     }), flush=True)
     return 0
 

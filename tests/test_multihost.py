@@ -18,10 +18,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training(tmp_path):
-    port = _free_port()
-    n = 2
+def _launch(n, port, extra=()):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # don't dial the TPU relay
     env["JAX_PLATFORMS"] = "cpu"
@@ -31,7 +28,8 @@ def test_two_process_training(tmp_path):
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(i), str(n), str(port)],
+            [sys.executable, worker, str(i), str(n), str(port),
+             *map(str, extra)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env, cwd=repo_root)
         for i in range(n)
@@ -47,17 +45,44 @@ def test_two_process_training(tmp_path):
                 p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    return outs
 
+
+def _results(outs, tag="MHRESULT "):
     results = []
     for out in outs:
-        lines = [l for l in out.splitlines() if l.startswith("MHRESULT ")]
-        assert lines, f"no MHRESULT in output:\n{out[-3000:]}"
-        results.append(json.loads(lines[0][len("MHRESULT "):]))
+        lines = [l for l in out.splitlines() if l.startswith(tag)]
+        assert lines, f"no {tag!r} in output:\n{out[-3000:]}"
+        results.append(lines[0][len(tag):])
+    return results
 
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    outs = _launch(2, _free_port())
+    results = [json.loads(r) for r in _results(outs)]
     for r in results:
         assert r["multihost"] is True
         assert r["n_processes"] == 2
         assert r["n_chips"] == 8  # 2 processes x 4 virtual devices
         assert r["steps"] == 6
     # both processes computed the identical replicated result
+    assert results[0]["accuracy"] == results[1]["accuracy"]
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_kill_resume(tmp_path):
+    """Config 5 end-to-end: multi-host async checkpoint, injected failure,
+    multi-host restore, completion. orbax coordinates the save across
+    processes (process 0 commits the directory)."""
+    ckpt = str(tmp_path / "mh-ckpt")
+    # run 1: both workers crash at step 5 (checkpoint saved at step 3)
+    outs = _launch(2, _free_port(), extra=(ckpt, 5))
+    assert all("MHFAILED injected" in o for o in outs)
+    # run 2: restore at step 3, finish steps 4-6
+    outs = _launch(2, _free_port(), extra=(ckpt,))
+    results = [json.loads(r) for r in _results(outs)]
+    for r in results:
+        assert r["restored"] is True
+        assert r["steps"] == 6
     assert results[0]["accuracy"] == results[1]["accuracy"]

@@ -1,0 +1,93 @@
+"""Observability + schedule tests: jax.profiler tracing via --profile-dir
+produces a trace on disk; LR schedules wire into training; bench.py's two
+modes emit well-formed single-line JSON."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu import optim, trainer
+from distributedmnist_tpu.config import Config
+from distributedmnist_tpu.data import synthetic_mnist
+
+
+BASE = Config(device="cpu", synthetic=True, log_every=0,
+              target_accuracy=None, model="mlp", optimizer="sgd",
+              learning_rate=0.02, batch_size=256, num_devices=8)
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    return synthetic_mnist(seed=2, train_n=2048, test_n=512)
+
+
+def test_profile_dir_writes_trace(tmp_path, small_data):
+    prof = str(tmp_path / "prof")
+    trainer.fit(BASE.replace(steps=4, eval_every=4, profile_dir=prof),
+                data=small_data)
+    found = []
+    for root, _, files in os.walk(prof):
+        found.extend(f for f in files
+                     if f.endswith((".pb", ".json.gz", ".trace.json.gz",
+                                    ".xplane.pb")))
+    assert found, f"no trace files under {prof}"
+
+
+def test_lr_schedule_constant_vs_cosine_differ(small_data):
+    a = trainer.fit(BASE.replace(steps=24, eval_every=24), data=small_data)
+    b = trainer.fit(BASE.replace(steps=24, eval_every=24,
+                                 lr_schedule="cosine"), data=small_data)
+    # same everything except the schedule: trajectories must differ
+    assert a["test_accuracy"] != b["test_accuracy"]
+
+
+def test_make_schedule_shapes():
+    s = optim.make_schedule(0.1, "warmup-cosine", warmup_steps=10,
+                            total_steps=100)
+    assert float(s(0)) == 0.0
+    assert abs(float(s(10)) - 0.1) < 1e-6   # peak at end of warmup
+    assert float(s(100)) < 1e-3             # decayed
+    with pytest.raises(ValueError, match="total_steps"):
+        optim.make_schedule(0.1, "cosine")
+    with pytest.raises(ValueError, match="unknown"):
+        optim.make_schedule(0.1, "sawtooth")
+
+
+def _run_bench(extra):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")] + extra,
+        capture_output=True, text=True, env=env, cwd=repo, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {out.stdout!r}"
+    return json.loads(lines[0])
+
+
+@pytest.mark.slow
+def test_bench_throughput_contract():
+    rec = _run_bench(["--bench-steps", "8", "--warmup-steps", "2",
+                      "--global-batch", "128"])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "detail"}
+    assert rec["metric"] == "train_images_per_sec_per_chip"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+
+
+@pytest.mark.slow
+def test_bench_time_to_accuracy_contract():
+    rec = _run_bench(["--mode", "time-to-accuracy", "--model", "mlp",
+                      "--target-accuracy", "0.5", "--global-batch", "256",
+                      "--max-epochs", "2"])
+    assert rec["metric"] == "wall_clock_to_target_accuracy"
+    assert rec["unit"] == "seconds"
+    assert rec["detail"]["reached_target"] is True
+    assert rec["detail"]["final_accuracy"] >= 0.5
