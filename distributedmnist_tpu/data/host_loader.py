@@ -5,10 +5,10 @@ The default pipeline (loader.DeviceDataset + IndexStream) keeps the whole
 dataset in HBM and moves only indices — optimal at MNIST scale. This module
 is the general form the reference's shard-by-rank DataLoader takes when the
 dataset outgrows HBM [BASELINE.json north_star: "per-host tf.data pipeline
-feeding device-sharded global batches"]: the host materializes each step's
-global batch rows and places them already sharded over 'data', so each
-device receives exactly its 1/n slice (per-process slices in multi-host via
-parallel.distributed.put_global — no cross-host data movement).
+feeding device-sharded global batches"]: jax.make_array_from_callback is
+handed a per-device row-gather callback, so each process only ever
+materializes the rows of its own devices' 'data' slices — no process builds
+the full global batch and there is no cross-host data movement.
 
 Batch order is IDENTICAL to the device-resident pipeline (same seeded
 epoch permutations via IndexStream's index math), so the two pipelines are
@@ -46,9 +46,7 @@ class HostStream:
 
     def next_block(self, k: int):
         import jax
-        idx = np.stack([self.indices.indices_for_step(self.indices.step + i)
-                        for i in range(k)])
-        self.indices.step += k
+        idx = self.indices.host_block(k)
 
         def put(arr):
             # Per-device callback: each device (and therefore each process)

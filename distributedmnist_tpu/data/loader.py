@@ -97,16 +97,25 @@ class IndexStream:
     def __iter__(self) -> Iterator[jax.Array]:
         return self
 
+    def host_block(self, k: int) -> np.ndarray:
+        """Host-side (k, global_batch) int32 index block for the next k
+        steps; advances the stream. The single source of batch order for
+        BOTH pipelines — device-resident (next_block) and streaming
+        (host_loader.HostStream) — so their order parity is structural,
+        not duplicated."""
+        idx = np.stack([self.indices_for_step(self.step + i)
+                        for i in range(k)]).astype(np.int32)
+        self.step += k
+        return idx
+
     def next_block(self, k: int) -> jax.Array:
         """Indices for the next k steps as one (k, global_batch) array,
         sharded P(None, 'data') — the K axis is scanned on device (one
         dispatch per block), the batch axis is split across chips."""
         from distributedmnist_tpu.parallel import distributed
-        idx = np.stack([self.indices_for_step(self.step + i)
-                        for i in range(k)]).astype(np.int32)
-        self.step += k
         return distributed.put_global(
-            idx, NamedSharding(self.mesh, P(None, "data")))
+            self.host_block(k),
+            NamedSharding(self.mesh, P(None, "data")))
 
     def __next__(self) -> jax.Array:
         return self.next_block(1)

@@ -29,6 +29,10 @@ def make_schedule(learning_rate: float, schedule: str = "constant",
     if schedule in ("cosine", "warmup-cosine"):
         if not total_steps:
             raise ValueError(f"{schedule} schedule needs total_steps")
+        if schedule == "warmup-cosine" and warmup_steps <= 0:
+            raise ValueError(
+                "warmup-cosine needs --warmup-steps > 0 (with 0 it would "
+                "silently start at peak LR; use 'cosine' for that)")
         # warmup_steps is honored by every schedule ("cosine" with warmup
         # is identical to "warmup-cosine"; the alias exists for CLI
         # symmetry with "constant").

@@ -330,6 +330,7 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     mlog = MetricsLogger()
     t_start = time.perf_counter()
     accuracy = 0.0
+    metrics = None
     reached_target_at: Optional[float] = None
     profiling = False
     if cfg.profile_dir and jax.process_index() == 0:
@@ -410,6 +411,8 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         "steps": int(state.step),
         "restored": restored,
         "test_accuracy": accuracy,
+        "final_loss": (None if metrics is None
+                       else float(jax.device_get(metrics["loss"]))),
         "target_accuracy": cfg.target_accuracy,
         "wall_clock_s": wall,
         "wall_clock_to_target_s": reached_target_at,
