@@ -12,6 +12,14 @@ accuracy"):
   --target-accuracy (train + eval, compile excluded from neither — this is
   the end-to-end number a user experiences).
 
+The measurement runs in a supervised worker subprocess: TPU runtime claims
+through tunneled/pooled backends can wedge forever before the first
+program runs (observed on this host's axon relay: the claim leg
+intermittently never completes while a fresh process succeeds). The
+supervisor watches worker stderr/stdout activity and kills + retries a
+worker that goes silent for --stall-timeout seconds, so one wedged claim
+cannot turn the benchmark into a hang. --inline bypasses supervision.
+
 vs_baseline: the reference publishes no numbers (BASELINE.md — empty mount,
 published={}); the only quantitative anchor is the driver's north-star
 target ">=99% in <30s on a v4-8 with near-linear scaling". For throughput
@@ -24,11 +32,100 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 TARGET_IPS_PER_CHIP = 2500.0
 TARGET_WALL_S = 30.0
+_WORKER_ENV = "DMNIST_BENCH_WORKER"
+
+
+def _mark(msg: str) -> None:
+    """Progress marker on stderr — the supervisor's liveness signal."""
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def _supervise(argv: list[str], stall_timeout: float,
+               attempts: int) -> int:
+    """Run this script as a worker subprocess; kill + retry if it produces
+    no output for stall_timeout seconds. Forwards the worker's single JSON
+    stdout line. No jax import happens in the supervisor."""
+    import signal
+    import subprocess
+    import threading
+
+    script = os.path.abspath(__file__)
+    for attempt in range(1, attempts + 1):
+        env = dict(os.environ, **{_WORKER_ENV: "1"})
+        proc = subprocess.Popen(
+            [sys.executable, "-u", script] + argv,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, start_new_session=True)
+        last = [time.monotonic()]
+        out_lines: list[str] = []
+
+        def pump(stream, sink):
+            for line in stream:
+                last[0] = time.monotonic()
+                sink(line)
+
+        threads = [
+            threading.Thread(
+                target=pump, args=(proc.stdout, out_lines.append),
+                daemon=True),
+            threading.Thread(
+                target=pump, args=(proc.stderr, sys.stderr.write),
+                daemon=True),
+        ]
+        for t in threads:
+            t.start()
+
+        def result_line():
+            """The worker's JSON result, or None. Only a parseable record
+            counts — a stray stdout line from a crashed worker must not be
+            forwarded as a benchmark result."""
+            for line in reversed(out_lines):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "metric" in rec:
+                    return line
+            return None
+
+        stalled = False
+        teardown_grace = min(30.0, stall_timeout)
+        while proc.poll() is None:
+            quiet = time.monotonic() - last[0]
+            if result_line() is not None and quiet > teardown_grace:
+                # Result already produced; only runtime teardown is
+                # hanging (pooled-backend clients can wedge at exit too).
+                break
+            if quiet > stall_timeout:
+                stalled = True
+                break
+            time.sleep(1)
+
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        proc.wait()
+        for t in threads:
+            t.join(timeout=5)
+
+        result = result_line()
+        if result is not None:
+            sys.stdout.write(result)
+            sys.stdout.flush()
+            return 0
+        reason = (f"no output for {stall_timeout:.0f}s" if stalled
+                  else f"exit code {proc.returncode}")
+        _mark(f"worker failed ({reason}), attempt {attempt}/{attempts}")
+    _mark("all attempts failed")
+    return 1
 
 
 def main(argv=None) -> int:
@@ -51,7 +148,16 @@ def main(argv=None) -> int:
                         "time-to-accuracy mode)")
     p.add_argument("--model", default="lenet")
     p.add_argument("--dtype", default="float32")
+    p.add_argument("--stall-timeout", type=float, default=300.0,
+                   help="kill+retry the worker if it is silent this long")
+    p.add_argument("--max-attempts", type=int, default=3,
+                   help="worker attempts before giving up")
+    p.add_argument("--inline", action="store_true",
+                   help="run in-process (no supervisor subprocess)")
     args = p.parse_args(argv)
+
+    # Cheap arg-only validation FIRST: a deterministic usage error must
+    # exit 2 immediately, not be retried in supervised subprocesses.
     if args.mode == "time-to-accuracy":
         # throughput-only knobs are rejected, not silently ignored
         # (--warmup-steps especially would read as LR warmup here)
@@ -59,11 +165,19 @@ def main(argv=None) -> int:
             p.error("--warmup-steps/--bench-steps are throughput-mode "
                     "flags; time-to-accuracy takes --max-epochs and "
                     "--steps-per-call")
+    else:
+        args.warmup_steps = (20 if args.warmup_steps is None
+                             else args.warmup_steps)
+        args.bench_steps = (200 if args.bench_steps is None
+                            else args.bench_steps)
+        if args.bench_steps < 1:
+            p.error("--bench-steps must be >= 1")
+
+    if not args.inline and os.environ.get(_WORKER_ENV) != "1":
+        return _supervise(list(sys.argv[1:] if argv is None else argv),
+                          args.stall_timeout, args.max_attempts)
+    if args.mode == "time-to-accuracy":
         return _time_to_accuracy(args)
-    args.warmup_steps = 20 if args.warmup_steps is None else args.warmup_steps
-    args.bench_steps = 200 if args.bench_steps is None else args.bench_steps
-    if args.bench_steps < 1:
-        p.error("--bench-steps must be >= 1")
 
     import jax
     import jax.numpy as jnp
@@ -74,9 +188,11 @@ def main(argv=None) -> int:
     from distributedmnist_tpu.parallel import make_mesh, replicated
     from distributedmnist_tpu.trainer import init_state, make_train_step
 
-    from distributedmnist_tpu.utils import round_up
+    from distributedmnist_tpu.utils import enable_compilation_cache, round_up
 
+    enable_compilation_cache()
     devs = jax.devices()
+    _mark(f"backend up: {len(devs)}x {devs[0].platform}")
     n_chips = len(devs)
     gb = round_up(args.global_batch, n_chips)
     mesh = make_mesh(devs)
@@ -111,11 +227,16 @@ def main(argv=None) -> int:
                                             stream.next_block(spc))
             if sync_every_step:
                 jax.block_until_ready(metrics["loss"])
-        jax.block_until_ready(metrics["loss"])
+        # Barrier on the FULL final state, not just the loss scalar: the
+        # dependency chain forces every queued block to completion, and
+        # fetching the updated params is the proof the work happened.
+        jax.block_until_ready((state_box[0], metrics))
         return blocks * spc
 
     state_box = [state]
+    _mark("state initialized; compiling + warmup")
     run(args.warmup_steps)
+    _mark("warmup done; timing")
     t0 = time.perf_counter()
     n_run = run(args.bench_steps)
     elapsed = time.perf_counter() - t0
@@ -142,13 +263,20 @@ def main(argv=None) -> int:
 
 
 def _time_to_accuracy(args) -> int:
+    import logging
+
     import jax
 
     from distributedmnist_tpu import trainer
     from distributedmnist_tpu.config import Config
     from distributedmnist_tpu.utils import round_up
 
+    # fit()'s INFO eval/summary lines double as the supervisor's liveness
+    # signal (and give the driver progress visibility).
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
     n_chips = len(jax.devices())
+    _mark(f"backend up: {n_chips} devices")
     gb = round_up(args.global_batch, n_chips)
     cfg = Config(model=args.model, optimizer="adam", learning_rate=2e-3,
                  lr_schedule="cosine",

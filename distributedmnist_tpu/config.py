@@ -74,6 +74,8 @@ class Config:
     log_every: int = 100
     # ops
     fused_kernels: str = "auto"     # {auto, pallas, xla}: pallas fused MLP layer
+    conv_impl: str = "auto"         # {auto, im2col, lax}: LeNet conv path
+                                    # (auto: patch-matmul on TPU, lax on CPU)
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -140,6 +142,8 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--log-every", type=int, default=None)
     p.add_argument("--fused-kernels", choices=["auto", "pallas", "xla"],
+                   default=None)
+    p.add_argument("--conv-impl", choices=["auto", "im2col", "lax"],
                    default=None)
     return p
 

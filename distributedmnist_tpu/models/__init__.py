@@ -3,14 +3,18 @@ from distributedmnist_tpu.models.lenet import LeNet5  # noqa: F401
 
 
 def build(name: str, dtype=None, fused: str = "auto",
-          platform: str | None = None):
+          platform: str | None = None, conv: str = "auto"):
     """Model factory for the two reference architectures
     [BASELINE.json configs: "2-layer MLP (784-128-10)", "LeNet-5 CNN"].
 
     `platform` is the platform of the devices the model will RUN on (the
     mesh's platform, not jax.default_backend()) — it resolves the 'auto'
-    fused-kernel mode; None falls back to the default backend.
+    fused-kernel mode and the 'auto' conv implementation; None falls back
+    to the default backend. conv in {'auto', 'im2col', 'lax'}: auto picks
+    the patch-matmul convs on TPU (MXU-native; see ops/conv.py) and lax
+    convs elsewhere. Both produce identical parameter pytrees.
     """
+    import jax
     import jax.numpy as jnp
 
     from distributedmnist_tpu.ops import fused as fused_lib
@@ -18,5 +22,11 @@ def build(name: str, dtype=None, fused: str = "auto",
     if name == "mlp":
         return MLP(dtype=dtype, fused=fused_lib.resolve(fused, platform))
     if name == "lenet":
-        return LeNet5(dtype=dtype)
+        if conv == "auto":
+            conv = ("im2col"
+                    if (platform or jax.default_backend()) == "tpu"
+                    else "lax")
+        if conv not in ("im2col", "lax"):
+            raise ValueError(f"unknown conv impl {conv!r}")
+        return LeNet5(dtype=dtype, conv_impl=conv)
     raise ValueError(f"unknown model {name!r} (expected mlp|lenet)")

@@ -7,8 +7,12 @@ Classic LeCun-98 LeNet-5 adapted to 28x28 MNIST input (the original takes
 modern variant, and what gets MNIST past 99% (SURVEY.md §7.3 notes LeNet-5
 is the model the wall-clock-to-99% harness must default to).
 
-TPU notes: convs lower straight to the MXU via XLA conv ops — no custom
-kernels needed (SURVEY.md §2 row 3). NHWC layout throughout (TPU-native).
+TPU notes: NHWC layout throughout (TPU-native). Two checkpoint-compatible
+conv implementations (identical param pytrees):
+- 'im2col' (TPU default): patch-matmul convs + reshape pooling
+  (ops/conv.py) — pure MXU matmuls in forward and backward.
+- 'lax': flax nn.Conv / nn.avg_pool lowering to XLA conv ops (CPU default;
+  also the cross-check oracle in tests/test_conv.py).
 """
 
 from __future__ import annotations
@@ -16,22 +20,35 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from distributedmnist_tpu.ops.conv import PatchConv, avg_pool2
+
 
 class LeNet5(nn.Module):
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "lax"          # {'lax', 'im2col'} — see module doc
 
     @nn.compact
     def __call__(self, x):
+        if self.conv_impl == "im2col":
+            def conv(feat, padding, name):
+                return PatchConv(feat, (5, 5), padding=padding,
+                                 dtype=self.dtype, name=name)
+            pool = avg_pool2
+        else:
+            def conv(feat, padding, name):
+                return nn.Conv(feat, (5, 5), padding=padding,
+                               dtype=self.dtype, name=name)
+
+            def pool(x):
+                return nn.avg_pool(x, (2, 2), strides=(2, 2))
         x = x.astype(self.dtype)                       # (B, 28, 28, 1)
-        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype,
-                    name="conv1")(x)                   # (B, 28, 28, 6)
+        x = conv(6, "SAME", "conv1")(x)                # (B, 28, 28, 6)
         x = nn.relu(x)
-        x = nn.avg_pool(x, (2, 2), strides=(2, 2))     # (B, 14, 14, 6)
-        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype,
-                    name="conv2")(x)                   # (B, 10, 10, 16)
+        x = pool(x)                                    # (B, 14, 14, 6)
+        x = conv(16, "VALID", "conv2")(x)              # (B, 10, 10, 16)
         x = nn.relu(x)
-        x = nn.avg_pool(x, (2, 2), strides=(2, 2))     # (B, 5, 5, 16)
+        x = pool(x)                                    # (B, 5, 5, 16)
         x = x.reshape((x.shape[0], -1))                # (B, 400)
         x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
         x = nn.relu(nn.Dense(84, dtype=self.dtype, name="fc2")(x))

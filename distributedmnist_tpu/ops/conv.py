@@ -1,0 +1,81 @@
+"""Patch-matmul (im2col) convolution + reshape pooling — the TPU conv path.
+
+A 5x5 conv on a 28x28 MNIST image is a tiny convolution, and the MXU is a
+matmul engine: the TPU-native formulation extracts the kh*kw shifted slices
+of the input once (static slices — XLA folds them into cheap pads/copies)
+and computes one (B*oh*ow, kh*kw*C) x (kh*kw*C, F) matmul. Forward AND
+backward then consist purely of matmuls and slice/pad ops — no
+conv_general_dilated anywhere — which keeps the whole training step on the
+MXU fast path and sidesteps XLA conv-backward lowering entirely (on this
+host's experimental 'axon' TPU platform, compiling any conv backward wedges
+the compiler indefinitely; measured: a single nn.Conv grad never finishes,
+the patch-matmul grad compiles in ~3s).
+
+avg_pool 2x2/2 is a reshape + mean over the two window axes — its backward
+is a broadcast, again avoiding reduce_window's backward lowering.
+
+Numerics match lax convs to float tolerance (accumulation order differs);
+equivalence is pinned by tests/test_conv.py. Parameter pytrees are
+IDENTICAL to flax nn.Conv ({kernel (kh,kw,C,F), bias (F,)}), so checkpoints
+written with either conv implementation restore into the other — the
+implementation choice is a per-run compute detail, not a model change.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def im2col_conv(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray,
+                padding: str = "VALID") -> jnp.ndarray:
+    """2-D convolution (NHWC, stride 1) as one patch matmul.
+
+    x (B,H,W,C), kernel (kh,kw,C,F), bias (F,). padding in {VALID, SAME}
+    (SAME requires odd kernel dims, which LeNet's 5x5 satisfies).
+    """
+    kh, kw, cin, feat = kernel.shape
+    if padding == "SAME":
+        x = jnp.pad(x, ((0, 0), (kh // 2, kh // 2),
+                        (kw // 2, kw // 2), (0, 0)))
+    elif padding != "VALID":
+        raise ValueError(f"unsupported padding {padding!r}")
+    b, h, w, c = x.shape
+    assert c == cin, (x.shape, kernel.shape)
+    oh, ow = h - kh + 1, w - kw + 1
+    # (B,oh,ow,kh*kw,C): kh*kw static shifted views; XLA lowers these to
+    # slices whose gradients are pads — no gather/scatter involved.
+    patches = jnp.stack([x[:, i:i + oh, j:j + ow, :]
+                         for i in range(kh) for j in range(kw)], axis=3)
+    patches = patches.reshape(b, oh, ow, kh * kw * c)
+    return patches @ kernel.reshape(kh * kw * cin, feat) + bias
+
+
+def avg_pool2(x: jnp.ndarray) -> jnp.ndarray:
+    """2x2 stride-2 average pool via reshape+mean (even H and W)."""
+    b, h, w, c = x.shape
+    return x.reshape(b, h // 2, 2, w // 2, 2, c).mean(axis=(2, 4))
+
+
+class PatchConv(nn.Module):
+    """Drop-in for nn.Conv(features, kernel_size, padding) with the
+    patch-matmul implementation; parameter names/shapes/init identical to
+    nn.Conv so the two are checkpoint-compatible."""
+
+    features: int
+    kernel_size: tuple[int, int]
+    padding: str = "VALID"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, x.shape[-1], self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        return im2col_conv(x.astype(self.dtype), kernel.astype(self.dtype),
+                           bias.astype(self.dtype), padding=self.padding)

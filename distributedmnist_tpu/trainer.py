@@ -224,7 +224,9 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     """Run one training workload end-to-end; returns the summary dict whose
     JSON form is the driver-facing result (SURVEY.md §2 row 11)."""
     from distributedmnist_tpu.checkpoint import Checkpointer  # lazy: orbax
+    from distributedmnist_tpu.utils import enable_compilation_cache
 
+    enable_compilation_cache()
     multihost = distributed.maybe_initialize(
         cfg.coordinator_address, cfg.num_processes, cfg.process_id)
     devices = get_devices(cfg.device, cfg.num_devices)
@@ -257,7 +259,7 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     # for unsharded operands, so TP runs force the XLA dense path.
     fused = "xla" if mp > 1 else cfg.fused_kernels
     model = models.build(cfg.model, dtype=dtype, fused=fused,
-                         platform=devices[0].platform)
+                         platform=devices[0].platform, conv=cfg.conv_impl)
     steps_per_epoch = ds.train_n // cfg.batch_size
     total_steps = cfg.steps if cfg.steps is not None \
         else cfg.epochs * steps_per_epoch

@@ -1,2 +1,3 @@
+from distributedmnist_tpu.utils.compile_cache import enable_compilation_cache  # noqa: F401
 from distributedmnist_tpu.utils.metrics import MetricsLogger, StepTimer  # noqa: F401
 from distributedmnist_tpu.utils.numerics import round_up  # noqa: F401
