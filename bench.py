@@ -140,11 +140,12 @@ def main(argv=None) -> int:
     p.add_argument("--warmup-steps", type=int, default=None,
                    help="[throughput] compile/warmup steps (default 20)")
     p.add_argument("--bench-steps", type=int, default=None,
-                   help="[throughput] timed steps, >= 1 (default 200)")
+                   help="[throughput] timed steps, >= 1 "
+                        "(default: 2048 on tpu, 64 on cpu)")
     p.add_argument("--steps-per-call", type=int, default=None,
                    help="optimizer steps fused per dispatch via lax.scan "
-                        "(default: 1 on cpu; on tpu 32 in throughput mode, "
-                        "largest divisor <= 64 of the eval cadence in "
+                        "(default: 1 on cpu; on tpu 256 in throughput mode, "
+                        "largest divisor <= 256 of the eval cadence in "
                         "time-to-accuracy mode)")
     p.add_argument("--model", default="lenet")
     p.add_argument("--dtype", default="float32")
@@ -168,9 +169,9 @@ def main(argv=None) -> int:
     else:
         args.warmup_steps = (20 if args.warmup_steps is None
                              else args.warmup_steps)
-        args.bench_steps = (200 if args.bench_steps is None
-                            else args.bench_steps)
-        if args.bench_steps < 1:
+        # bench_steps default is platform-dependent; resolved in the
+        # worker once the backend is known.
+        if args.bench_steps is not None and args.bench_steps < 1:
             p.error("--bench-steps must be >= 1")
 
     if not args.inline and os.environ.get(_WORKER_ENV) != "1":
@@ -214,7 +215,9 @@ def main(argv=None) -> int:
     # programs (small host thread pool); TPU pipelines safely.
     sync_every_step = devs[0].platform == "cpu"
     spc = (max(1, args.steps_per_call) if args.steps_per_call is not None
-           else (1 if sync_every_step else 32))
+           else (1 if sync_every_step else 256))
+    if args.bench_steps is None:
+        args.bench_steps = 64 if sync_every_step else 2048
 
     def run(n_steps):
         """Run >= n_steps optimizer steps in blocks of spc; returns the

@@ -202,8 +202,10 @@ def make_eval_fn(model, mesh, dtype=jnp.float32):
 
 def _pick_steps_per_call(cfg: Config, platform: str, has_ckpt: bool) -> int:
     """Steps fused per XLA dispatch. Auto: 1 on CPU (synchronous, small
-    thread pool); on TPU the largest k <= 64 dividing the eval/checkpoint
-    cadence, so block edges land exactly on eval and checkpoint steps."""
+    thread pool); on TPU the largest k <= 256 dividing the eval/checkpoint
+    cadence, so block edges land exactly on eval and checkpoint steps.
+    (lax.scan compiles its body once, so compile time is k-independent;
+    measured throughput plateaus around k=256 on the v5e here.)"""
     if cfg.steps_per_call is not None:
         return max(1, cfg.steps_per_call)
     if platform == "cpu":
@@ -214,7 +216,7 @@ def _pick_steps_per_call(cfg: Config, platform: str, has_ckpt: bool) -> int:
         cadence = math.gcd(cadence, cfg.checkpoint_every)
     if cfg.fail_at_step:
         cadence = math.gcd(cadence, cfg.fail_at_step)
-    for k in range(min(64, cadence), 0, -1):
+    for k in range(min(256, cadence), 0, -1):
         if cadence % k == 0:
             return k
     return 1

@@ -74,10 +74,10 @@ def test_fit_steps_per_call_matches_default(tiny_data):
 def test_pick_steps_per_call():
     cfg = Config(eval_every=200, checkpoint_every=500)
     assert trainer._pick_steps_per_call(cfg, "cpu", False) == 1
-    # tpu: largest k <= 64 dividing eval_every
-    assert trainer._pick_steps_per_call(cfg, "tpu", False) == 50
+    # tpu: largest k <= 256 dividing eval_every
+    assert trainer._pick_steps_per_call(cfg, "tpu", False) == 200
     # with checkpointing: divides gcd(200, 500) = 100
-    assert trainer._pick_steps_per_call(cfg, "tpu", True) == 50
+    assert trainer._pick_steps_per_call(cfg, "tpu", True) == 100
     assert trainer._pick_steps_per_call(
         cfg.replace(steps_per_call=7), "tpu", True) == 7
     assert trainer._pick_steps_per_call(
