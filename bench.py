@@ -38,94 +38,11 @@ import time
 
 TARGET_IPS_PER_CHIP = 2500.0
 TARGET_WALL_S = 30.0
-_WORKER_ENV = "DMNIST_BENCH_WORKER"
 
 
 def _mark(msg: str) -> None:
     """Progress marker on stderr — the supervisor's liveness signal."""
     print(f"bench: {msg}", file=sys.stderr, flush=True)
-
-
-def _supervise(argv: list[str], stall_timeout: float,
-               attempts: int) -> int:
-    """Run this script as a worker subprocess; kill + retry if it produces
-    no output for stall_timeout seconds. Forwards the worker's single JSON
-    stdout line. No jax import happens in the supervisor."""
-    import signal
-    import subprocess
-    import threading
-
-    script = os.path.abspath(__file__)
-    for attempt in range(1, attempts + 1):
-        env = dict(os.environ, **{_WORKER_ENV: "1"})
-        proc = subprocess.Popen(
-            [sys.executable, "-u", script] + argv,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True, env=env, start_new_session=True)
-        last = [time.monotonic()]
-        out_lines: list[str] = []
-
-        def pump(stream, sink):
-            for line in stream:
-                last[0] = time.monotonic()
-                sink(line)
-
-        threads = [
-            threading.Thread(
-                target=pump, args=(proc.stdout, out_lines.append),
-                daemon=True),
-            threading.Thread(
-                target=pump, args=(proc.stderr, sys.stderr.write),
-                daemon=True),
-        ]
-        for t in threads:
-            t.start()
-
-        def result_line():
-            """The worker's JSON result, or None. Only a parseable record
-            counts — a stray stdout line from a crashed worker must not be
-            forwarded as a benchmark result."""
-            for line in reversed(out_lines):
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(rec, dict) and "metric" in rec:
-                    return line
-            return None
-
-        stalled = False
-        teardown_grace = min(30.0, stall_timeout)
-        while proc.poll() is None:
-            quiet = time.monotonic() - last[0]
-            if result_line() is not None and quiet > teardown_grace:
-                # Result already produced; only runtime teardown is
-                # hanging (pooled-backend clients can wedge at exit too).
-                break
-            if quiet > stall_timeout:
-                stalled = True
-                break
-            time.sleep(1)
-
-        if proc.poll() is None:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-        proc.wait()
-        for t in threads:
-            t.join(timeout=5)
-
-        result = result_line()
-        if result is not None:
-            sys.stdout.write(result)
-            sys.stdout.flush()
-            return 0
-        reason = (f"no output for {stall_timeout:.0f}s" if stalled
-                  else f"exit code {proc.returncode}")
-        _mark(f"worker failed ({reason}), attempt {attempt}/{attempts}")
-    _mark("all attempts failed")
-    return 1
 
 
 def main(argv=None) -> int:
@@ -174,9 +91,14 @@ def main(argv=None) -> int:
         if args.bench_steps is not None and args.bench_steps < 1:
             p.error("--bench-steps must be >= 1")
 
-    if not args.inline and os.environ.get(_WORKER_ENV) != "1":
-        return _supervise(list(sys.argv[1:] if argv is None else argv),
-                          args.stall_timeout, args.max_attempts)
+    from distributedmnist_tpu.utils import supervise
+
+    if not args.inline and not supervise.is_worker():
+        return supervise.run_supervised(
+            os.path.abspath(__file__),
+            list(sys.argv[1:] if argv is None else argv),
+            accept=supervise.json_record_acceptor("metric"),
+            stall_timeout=args.stall_timeout, attempts=args.max_attempts)
     if args.mode == "time-to-accuracy":
         return _time_to_accuracy(args)
 
@@ -219,6 +141,8 @@ def main(argv=None) -> int:
     if args.bench_steps is None:
         args.bench_steps = 64 if sync_every_step else 2048
 
+    from distributedmnist_tpu.utils import StepTimer
+
     def run(n_steps):
         """Run >= n_steps optimizer steps in blocks of spc; returns the
         exact step count executed."""
@@ -230,10 +154,12 @@ def main(argv=None) -> int:
                                             stream.next_block(spc))
             if sync_every_step:
                 jax.block_until_ready(metrics["loss"])
-        # Barrier on the FULL final state, not just the loss scalar: the
-        # dependency chain forces every queued block to completion, and
-        # fetching the updated params is the proof the work happened.
-        jax.block_until_ready((state_box[0], metrics))
+        # The clock stops on a device->host VALUE fetch of the final
+        # block's loss: its dependency chain covers every queued block,
+        # and on pooled/tunneled backends block_until_ready can return
+        # before execution completes (StepTimer.barrier) — fetched bytes
+        # are the only proof the work happened.
+        StepTimer.barrier(metrics["loss"])
         return blocks * spc
 
     state_box = [state]

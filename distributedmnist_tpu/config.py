@@ -72,6 +72,9 @@ class Config:
     # observability
     profile_dir: Optional[str] = None  # jax.profiler trace output
     log_every: int = 100
+    # gradient accumulation: microbatches per optimizer step (device-
+    # resident pipeline only; one allreduce per step regardless)
+    grad_accum: int = 1
     # ops
     fused_kernels: str = "auto"     # {auto, pallas, xla}: pallas fused MLP layer
     conv_impl: str = "auto"         # {auto, im2col, lax}: LeNet conv path
@@ -145,6 +148,8 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--conv-impl", choices=["auto", "im2col", "lax"],
                    default=None)
+    p.add_argument("--grad-accum", type=int, default=None,
+                   help="microbatches accumulated per optimizer step")
     return p
 
 

@@ -79,19 +79,44 @@ def _forward_loss(model, dtype):
     return loss_fn
 
 
+def _apply_update(tx, state, grads):
+    """optimizer update -> next TrainState. The single spelling of the
+    update shared by both SPMD modes and the grad-accum branches."""
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(step=state.step + 1, params=params,
+                      opt_state=opt_state)
+
+
 def _make_one_step(loss_fn, tx):
     """grad -> optimizer update -> new state, for one (x, y) batch."""
     def one_step(state, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(step=state.step + 1, params=params,
-                          opt_state=opt_state), loss
+        return _apply_update(tx, state, grads), loss
     return one_step
 
 
+def _accumulate_grads(loss_fn, params, micro_batches, grad_accum):
+    """Mean loss and gradients over `grad_accum` microbatches, via an inner
+    lax.scan. micro_batches is a callable i -> (x, y) producing the i-th
+    microbatch (already sharded); equal microbatch sizes make the mean of
+    microbatch means the exact full-batch gradient."""
+    def micro(carry, i):
+        g_acc, l_acc = carry
+        x, y = micro_batches(i)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (g_sum, l_sum), _ = jax.lax.scan(
+        micro, (zeros, jnp.zeros((), jnp.float32)),
+        jnp.arange(grad_accum))
+    inv = 1.0 / grad_accum
+    return l_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
+
+
 def make_train_step(model, tx, mesh, mode: str = "auto",
-                    dtype=jnp.float32):
+                    dtype=jnp.float32, grad_accum: int = 1):
     """Build the jitted train step: (state, train_x, train_y, idx_block) ->
     (state, metrics).
 
@@ -101,6 +126,14 @@ def make_train_step(model, tx, mesh, mode: str = "auto",
     steps amortizes it K-fold). The leading K axis is scanned; the batch
     axis is sharded over 'data'. The dataset arrays are replicated.
     metrics = {"loss": last-step loss, "loss_mean": mean over the block}.
+
+    grad_accum > 1 splits each optimizer step's global batch into that
+    many microbatches, accumulating gradients in an inner scan before the
+    single optimizer update. Each microbatch is itself sharded over 'data'
+    (the gather source is replicated, so microbatching adds no
+    communication); in explicit mode the gradient allreduce still happens
+    ONCE per optimizer step, after accumulation — the classic
+    communication win of accumulation.
     """
     loss_fn = _forward_loss(model, dtype)
     one_step = _make_one_step(loss_fn, tx)
@@ -108,13 +141,23 @@ def make_train_step(model, tx, mesh, mode: str = "auto",
     if mode == "auto":
         batch_spec = NamedSharding(mesh, P(DATA_AXIS))
 
+        def _gather(train_x, train_y, idx):
+            x = jax.lax.with_sharding_constraint(
+                jnp.take(train_x, idx, axis=0), batch_spec)
+            y = jax.lax.with_sharding_constraint(
+                jnp.take(train_y, idx, axis=0), batch_spec)
+            return x, y
+
         def _block(state, train_x, train_y, idx_block):
             def body(state, idx):
-                x = jax.lax.with_sharding_constraint(
-                    jnp.take(train_x, idx, axis=0), batch_spec)
-                y = jax.lax.with_sharding_constraint(
-                    jnp.take(train_y, idx, axis=0), batch_spec)
-                return one_step(state, x, y)
+                if grad_accum == 1:
+                    return one_step(state, *_gather(train_x, train_y, idx))
+                idx_m = idx.reshape(grad_accum, -1)
+                loss, grads = _accumulate_grads(
+                    loss_fn, state.params,
+                    lambda i: _gather(train_x, train_y, idx_m[i]),
+                    grad_accum)
+                return _apply_update(tx, state, grads), loss
 
             state, losses = jax.lax.scan(body, state, idx_block)
             return state, {"loss": losses[-1], "loss_mean": losses.mean()}
@@ -123,7 +166,7 @@ def make_train_step(model, tx, mesh, mode: str = "auto",
 
     if mode != "explicit":
         raise ValueError(f"unknown spmd mode {mode!r}")
-    return _make_explicit_step(loss_fn, tx, mesh)
+    return _make_explicit_step(loss_fn, tx, mesh, grad_accum)
 
 
 def make_train_step_from_batches(model, tx, mesh, dtype=jnp.float32):
@@ -148,23 +191,28 @@ def make_train_step_from_batches(model, tx, mesh, dtype=jnp.float32):
     return jax.jit(_block, donate_argnums=0)
 
 
-def _make_explicit_step(loss_fn, tx, mesh):
+def _make_explicit_step(loss_fn, tx, mesh, grad_accum: int = 1):
     # explicit: the reference's per-step gradient allreduce, spelled out as
     # lax.pmean over the named 'data' axis inside shard_map [north_star].
     def _local_block(state, train_x, train_y, idx_block):
         def body(state, idx):             # idx is the LOCAL shard here
-            x = jnp.take(train_x, idx, axis=0)
-            y = jnp.take(train_y, idx, axis=0)
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
+            if grad_accum == 1:
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    state.params, jnp.take(train_x, idx, axis=0),
+                    jnp.take(train_y, idx, axis=0))
+            else:
+                idx_m = idx.reshape(grad_accum, -1)
+                loss, grads = _accumulate_grads(
+                    loss_fn, state.params,
+                    lambda i: (jnp.take(train_x, idx_m[i], axis=0),
+                               jnp.take(train_y, idx_m[i], axis=0)),
+                    grad_accum)
             # Equal shard sizes (enforced at config time) make
-            # pmean-of-means the exact global mean.
+            # pmean-of-means the exact global mean. With accumulation the
+            # allreduce still happens once per optimizer step.
             grads = jax.lax.pmean(grads, DATA_AXIS)
             loss = jax.lax.pmean(loss, DATA_AXIS)
-            updates, opt_state = tx.update(grads, state.opt_state,
-                                           state.params)
-            params = optax.apply_updates(state.params, updates)
-            return TrainState(step=state.step + 1, params=params,
-                              opt_state=opt_state), loss
+            return _apply_update(tx, state, grads), loss
 
         state, losses = jax.lax.scan(body, state, idx_block)
         return state, {"loss": losses[-1], "loss_mean": losses.mean()}
@@ -245,6 +293,13 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         raise ValueError(
             f"global batch {cfg.batch_size} not divisible by "
             f"{dp_size} data-parallel chips")
+    ga = cfg.grad_accum
+    if ga < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {ga}")
+    if ga > 1 and cfg.batch_size % (dp_size * ga):
+        raise ValueError(
+            f"global batch {cfg.batch_size} not divisible by "
+            f"{dp_size} chips x {ga} grad-accum microbatches")
     mesh = make_mesh(devices, mp)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
@@ -253,6 +308,11 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     streaming = cfg.data_pipeline == "stream"
     if streaming and cfg.spmd_mode == "explicit":
         raise ValueError("data_pipeline=stream requires spmd_mode=auto")
+    if streaming and ga > 1:
+        raise ValueError("grad_accum > 1 requires the device-resident "
+                         "pipeline (microbatches re-gather from the "
+                         "replicated dataset; pre-gathered streamed "
+                         "batches would reshard on every split)")
     data = data if data is not None else load_mnist(
         cfg.data_dir, cfg.synthetic, cfg.seed)
     ds = DeviceDataset(data, mesh, device_resident_train=not streaming)
@@ -297,7 +357,8 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     else:
         stream = IndexStream(ds.train_n, cfg.batch_size, cfg.seed, mesh,
                              start_step=start_step)
-        step_fn = make_train_step(model, tx, mesh, cfg.spmd_mode, dtype)
+        step_fn = make_train_step(model, tx, mesh, cfg.spmd_mode, dtype,
+                                  grad_accum=ga)
 
         def run_block(state, k):
             return step_fn(state, ds.train_x, ds.train_y,

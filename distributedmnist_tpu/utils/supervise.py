@@ -1,0 +1,121 @@
+"""Stall-watchdog process supervision for flaky pooled TPU backends.
+
+A fresh process's first device claim through a pooled/tunneled TPU runtime
+can wedge forever before any program runs (observed repeatedly on this
+host's relay: the claim leg intermittently never completes while an
+immediate retry in a new process succeeds). The supervisor runs the real
+work in a worker subprocess, watches its stdout/stderr for activity, and
+kills + retries a worker that goes silent too long. Acceptance of a
+worker's output is delegated to the caller (e.g. "a parseable JSON record
+with a 'metric' key"), so a crashed worker's stray output is never
+forwarded as a result.
+
+Used by bench.py (always) and train.py (--supervise).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+_WORKER_ENV = "DMNIST_SUPERVISED_WORKER"
+
+
+def is_worker() -> bool:
+    return os.environ.get(_WORKER_ENV) == "1"
+
+
+def mark(msg: str) -> None:
+    """Progress marker on stderr — the supervisor's liveness signal."""
+    print(f"supervise: {msg}", file=sys.stderr, flush=True)
+
+
+def run_supervised(script: str, argv: list[str],
+                   accept: Callable[[list[str]], Optional[str]],
+                   stall_timeout: float = 300.0,
+                   attempts: int = 3) -> int:
+    """Run `python -u script *argv` as a worker (marked via env); kill +
+    retry if it produces no output for stall_timeout seconds. `accept`
+    maps the worker's stdout lines to the result to forward (or None if
+    the output contains no valid result). Returns the exit code; the
+    accepted result is written to stdout. Never imports jax."""
+    for attempt in range(1, attempts + 1):
+        env = dict(os.environ, **{_WORKER_ENV: "1"})
+        proc = subprocess.Popen(
+            [sys.executable, "-u", script] + argv,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, start_new_session=True)
+        last = [time.monotonic()]
+        out_lines: list[str] = []
+
+        def pump(stream, sink):
+            for line in stream:
+                last[0] = time.monotonic()
+                sink(line)
+
+        import threading
+        threads = [
+            threading.Thread(target=pump,
+                             args=(proc.stdout, out_lines.append),
+                             daemon=True),
+            threading.Thread(target=pump,
+                             args=(proc.stderr, sys.stderr.write),
+                             daemon=True),
+        ]
+        for t in threads:
+            t.start()
+
+        stalled = False
+        teardown_grace = min(30.0, stall_timeout)
+        while proc.poll() is None:
+            quiet = time.monotonic() - last[0]
+            if accept(out_lines) is not None and quiet > teardown_grace:
+                # Result produced; only runtime teardown is hanging
+                # (pooled-backend clients can wedge at exit too).
+                break
+            if quiet > stall_timeout:
+                stalled = True
+                break
+            time.sleep(1)
+
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        proc.wait()
+        for t in threads:
+            t.join(timeout=5)
+
+        result = accept(out_lines)
+        if result is not None:
+            sys.stdout.write(result)
+            sys.stdout.flush()
+            return 0
+        reason = (f"no output for {stall_timeout:.0f}s" if stalled
+                  else f"exit code {proc.returncode}")
+        mark(f"worker failed ({reason}), attempt {attempt}/{attempts}")
+    mark("all attempts failed")
+    return 1
+
+
+def json_record_acceptor(required_key: str):
+    """accept() factory: the last stdout line that parses as a JSON object
+    containing `required_key`."""
+    import json
+
+    def accept(out_lines: list[str]) -> Optional[str]:
+        for line in reversed(out_lines):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and required_key in rec:
+                return line
+        return None
+
+    return accept

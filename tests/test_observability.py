@@ -89,6 +89,27 @@ def test_bench_throughput_contract():
 
 
 @pytest.mark.slow
+def test_train_supervised_forwards_summary():
+    """--supervise runs fit in a watchdog-supervised worker and forwards
+    the summary JSON line (utils/supervise.py)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "train.py"), "--supervise",
+         "--device", "cpu", "--synthetic", "--model", "mlp",
+         "--num-devices", "8", "--batch-size", "256", "--steps", "8",
+         "--eval-every", "8", "--log-every", "0"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.splitlines()[-1])
+    assert rec["steps"] == 8 and "test_accuracy" in rec
+
+
+@pytest.mark.slow
 def test_bench_time_to_accuracy_contract():
     rec = _run_bench(["--mode", "time-to-accuracy", "--model", "mlp",
                       "--target-accuracy", "0.5", "--global-batch", "256",

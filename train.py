@@ -32,7 +32,28 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     config_lib.add_args(p)
-    cfg = config_lib.from_args(p.parse_args(argv))
+    p.add_argument("--supervise", action="store_true",
+                   help="run training in a watchdog-supervised worker "
+                        "subprocess and retry if the TPU runtime wedges "
+                        "before making progress (pooled-backend claim "
+                        "hangs); the summary JSON line is forwarded")
+    p.add_argument("--stall-timeout", type=float, default=300.0,
+                   help="[--supervise] kill+retry the worker if it is "
+                        "silent this long")
+    p.add_argument("--max-attempts", type=int, default=3,
+                   help="[--supervise] worker attempts before giving up")
+    args = p.parse_args(argv)
+    cfg = config_lib.from_args(args)
+
+    from distributedmnist_tpu.utils import supervise
+    if args.supervise and not supervise.is_worker():
+        import os
+        worker_argv = [a for a in (sys.argv[1:] if argv is None else argv)
+                       if a != "--supervise"]
+        return supervise.run_supervised(
+            os.path.abspath(__file__), worker_argv,
+            accept=supervise.json_record_acceptor("test_accuracy"),
+            stall_timeout=args.stall_timeout, attempts=args.max_attempts)
 
     from distributedmnist_tpu import trainer  # after flags: jax import cost
     summary = trainer.fit(cfg)
