@@ -415,8 +415,12 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
                                               # once; only at the very end
             # Block BEFORE dispatching so at most max_inflight programs are
             # ever concurrently in flight (cap 1 on CPU really means 1).
+            # Drain via a value fetch: on pooled/relay backends
+            # block_until_ready returns before execution completes
+            # (StepTimer.barrier), which would let queue depth grow
+            # unbounded here.
             while len(inflight) >= max_inflight:
-                jax.block_until_ready(inflight.popleft())
+                StepTimer.barrier(inflight.popleft())
             state, metrics = run_block(state, k)
             inflight.append(metrics["loss"])
             prev, step = step, step + k

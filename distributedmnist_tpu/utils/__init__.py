@@ -1,3 +1,26 @@
-from distributedmnist_tpu.utils.compile_cache import enable_compilation_cache  # noqa: F401
-from distributedmnist_tpu.utils.metrics import MetricsLogger, StepTimer  # noqa: F401
-from distributedmnist_tpu.utils.numerics import round_up  # noqa: F401
+"""Utils package. Submodule attributes resolve lazily (PEP 562) so that
+importing `distributedmnist_tpu.utils.supervise` from a supervisor parent
+process does NOT pull in jax via metrics.py — the supervisor must stay
+jax-free so a wedge at backend/plugin import time is confined to the
+killable worker subprocess (utils/supervise.py's contract)."""
+
+_EXPORTS = {
+    "MetricsLogger": ("distributedmnist_tpu.utils.metrics", "MetricsLogger"),
+    "StepTimer": ("distributedmnist_tpu.utils.metrics", "StepTimer"),
+    "round_up": ("distributedmnist_tpu.utils.numerics", "round_up"),
+    "enable_compilation_cache": (
+        "distributedmnist_tpu.utils.compile_cache",
+        "enable_compilation_cache"),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(module_name), attr)

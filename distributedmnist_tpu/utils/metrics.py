@@ -2,8 +2,10 @@
 machine-readable JSON summary [BASELINE.json metric: "MNIST images/sec/chip;
 wall-clock to 99% test accuracy"; SURVEY.md §2 row 11, §5].
 
-Timing respects JAX's async dispatch: StepTimer only closes a window after a
-`jax.block_until_ready` on the last step's output, so measured step time is
+Timing respects JAX's async dispatch: StepTimer only closes a window after
+a device->host VALUE fetch of the last step's output (StepTimer.barrier) —
+not block_until_ready, which on pooled/tunneled PJRT backends can report
+ready long before execution completes. Measured step time is therefore true
 device time + dispatch, not just host dispatch.
 """
 

@@ -1,0 +1,95 @@
+"""utils/supervise.py unit tests — driven with tiny stub worker scripts
+(no jax): acceptance only on parseable JSON records, stall kill + retry,
+and the teardown-grace path where a worker produces its result but wedges
+at exit.
+"""
+
+import json
+import sys
+
+import pytest
+
+from distributedmnist_tpu.utils import supervise
+
+
+def _write(tmp_path, body):
+    script = tmp_path / "worker.py"
+    script.write_text(body)
+    return str(script)
+
+
+def _accept():
+    return supervise.json_record_acceptor("metric")
+
+
+def test_forwards_json_result(tmp_path, capfd):
+    script = _write(tmp_path, """
+import json
+print("some banner line")
+print(json.dumps({"metric": "m", "value": 1}))
+""")
+    rc = supervise.run_supervised(script, [], _accept(),
+                                  stall_timeout=30, attempts=1)
+    assert rc == 0
+    out = capfd.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == {"metric": "m", "value": 1}
+
+
+def test_crash_without_result_retries_then_fails(tmp_path, capfd):
+    script = _write(tmp_path, """
+import sys
+print("not a json result")
+sys.exit(3)
+""")
+    rc = supervise.run_supervised(script, [], _accept(),
+                                  stall_timeout=30, attempts=2)
+    assert rc == 1
+    err = capfd.readouterr().err
+    assert "attempt 1/2" in err and "attempt 2/2" in err
+    assert "exit code 3" in err
+
+
+def test_silent_stall_is_killed(tmp_path, capfd):
+    script = _write(tmp_path, """
+import time
+time.sleep(600)
+""")
+    rc = supervise.run_supervised(script, [], _accept(),
+                                  stall_timeout=2, attempts=1)
+    assert rc == 1
+    assert "no output for 2s" in capfd.readouterr().err
+
+
+def test_result_then_teardown_wedge_is_accepted(tmp_path, capfd):
+    script = _write(tmp_path, """
+import json, time
+print(json.dumps({"metric": "m", "value": 2}))
+time.sleep(600)                     # wedged runtime teardown
+""")
+    rc = supervise.run_supervised(script, [], _accept(),
+                                  stall_timeout=4, attempts=1)
+    assert rc == 0
+    out = capfd.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1])["value"] == 2
+
+
+def test_worker_env_marker(tmp_path, capfd):
+    script = _write(tmp_path, """
+import json, os
+print(json.dumps({"metric": "env",
+                  "worker": os.environ.get("DMNIST_SUPERVISED_WORKER")}))
+""")
+    assert not supervise.is_worker()
+    rc = supervise.run_supervised(script, [], _accept(),
+                                  stall_timeout=30, attempts=1)
+    assert rc == 0
+    rec = json.loads(capfd.readouterr().out.strip().splitlines()[-1])
+    assert rec["worker"] == "1"
+
+
+def test_acceptor_ignores_non_record_json():
+    accept = _accept()
+    assert accept(["[1, 2]\n", "42\n", '"metric"\n']) is None
+    assert accept(['{"other": 1}\n']) is None
+    line = '{"metric": "m"}\n'
+    assert accept(["junk\n", line]) == line
