@@ -17,7 +17,7 @@ equivalence test possible (SURVEY.md §4).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator
 
 import jax
 import numpy as np

@@ -24,7 +24,6 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributedmnist_tpu.parallel.mesh import DATA_AXIS
 
 
 def maybe_initialize(coordinator_address: Optional[str],

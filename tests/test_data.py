@@ -5,7 +5,6 @@ import gzip
 import os
 import struct
 
-import jax
 import numpy as np
 import pytest
 

@@ -2,7 +2,6 @@
 with the device-resident pipeline, sharding of the streamed blocks, and
 trajectory equivalence through fit()."""
 
-import jax
 import numpy as np
 import pytest
 

@@ -3,7 +3,6 @@ no toolchain), asserts byte-identical parity with the Python parser and
 permutation validity/determinism."""
 
 import gzip
-import os
 import struct
 
 import numpy as np

@@ -5,9 +5,6 @@ at exit.
 """
 
 import json
-import sys
-
-import pytest
 
 from distributedmnist_tpu.utils import supervise
 
