@@ -100,11 +100,19 @@ def main(argv=None) -> int:
     from distributedmnist_tpu.utils import supervise
 
     if not args.inline and not supervise.is_worker():
+        # Last-resort fallback: if every attempt on the default backend
+        # fails (e.g. the TPU runtime is down hard), record a
+        # clearly-labelled CPU number (detail.backend says "cpu") rather
+        # than nothing. Unsetting PALLAS_AXON_POOL_IPS disables this
+        # host's TPU plugin registration (the repo-wide convention, cf.
+        # conftest.py); JAX_PLATFORMS=cpu forces the backend.
         return supervise.run_supervised(
             os.path.abspath(__file__),
             list(sys.argv[1:] if argv is None else argv),
             accept=supervise.json_record_acceptor("metric"),
-            stall_timeout=args.stall_timeout, attempts=args.max_attempts)
+            stall_timeout=args.stall_timeout, attempts=args.max_attempts,
+            fallback_env={"JAX_PLATFORMS": "cpu",
+                          "PALLAS_AXON_POOL_IPS": None})
     if args.mode == "time-to-accuracy":
         return _time_to_accuracy(args)
 

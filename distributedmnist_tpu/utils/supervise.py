@@ -37,14 +37,28 @@ def mark(msg: str) -> None:
 def run_supervised(script: str, argv: list[str],
                    accept: Callable[[list[str]], Optional[str]],
                    stall_timeout: float = 300.0,
-                   attempts: int = 3) -> int:
+                   attempts: int = 3,
+                   fallback_env: Optional[dict] = None) -> int:
     """Run `python -u script *argv` as a worker (marked via env); kill +
     retry if it produces no output for stall_timeout seconds. `accept`
     maps the worker's stdout lines to the result to forward (or None if
     the output contains no valid result). Returns the exit code; the
-    accepted result is written to stdout. Never imports jax."""
-    for attempt in range(1, attempts + 1):
+    accepted result is written to stdout. Never imports jax.
+
+    If every attempt fails and `fallback_env` is given, ONE extra attempt
+    runs with those env overrides (a None value UNSETS the variable) —
+    e.g. forcing the CPU backend so a dead TPU runtime still yields a
+    (clearly labelled) result instead of nothing."""
+    total = attempts + (1 if fallback_env else 0)
+    for attempt in range(1, total + 1):
         env = dict(os.environ, **{_WORKER_ENV: "1"})
+        if attempt > attempts:
+            mark(f"fallback attempt with env overrides {fallback_env}")
+            for key, val in fallback_env.items():
+                if val is None:
+                    env.pop(key, None)
+                else:
+                    env[key] = val
         proc = subprocess.Popen(
             [sys.executable, "-u", script] + argv,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -98,7 +112,7 @@ def run_supervised(script: str, argv: list[str],
             return 0
         reason = (f"no output for {stall_timeout:.0f}s" if stalled
                   else f"exit code {proc.returncode}")
-        mark(f"worker failed ({reason}), attempt {attempt}/{attempts}")
+        mark(f"worker failed ({reason}), attempt {attempt}/{total}")
     mark("all attempts failed")
     return 1
 

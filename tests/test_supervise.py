@@ -84,6 +84,28 @@ print(json.dumps({"metric": "env",
     assert rec["worker"] == "1"
 
 
+def test_fallback_env_used_after_exhausted_attempts(tmp_path, capfd):
+    script = _write(tmp_path, """
+import json, os, sys
+if os.environ.get("FORCE_OK") != "1" or "POISON" in os.environ:
+    sys.exit(7)                     # primary backend 'dead'
+print(json.dumps({"metric": "m", "value": 9}))
+""")
+    import os
+    os.environ["POISON"] = "x"      # must be UNSET by the None override
+    try:
+        rc = supervise.run_supervised(
+            script, [], _accept(), stall_timeout=30, attempts=2,
+            fallback_env={"FORCE_OK": "1", "POISON": None})
+    finally:
+        del os.environ["POISON"]
+    assert rc == 0
+    cap = capfd.readouterr()
+    assert json.loads(cap.out.strip().splitlines()[-1])["value"] == 9
+    assert "attempt 1/3" in cap.err and "attempt 2/3" in cap.err
+    assert "fallback attempt" in cap.err
+
+
 def test_acceptor_ignores_non_record_json():
     accept = _accept()
     assert accept(["[1, 2]\n", "42\n", '"metric"\n']) is None
