@@ -58,6 +58,7 @@ class Config:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 500     # steps between async saves
     resume: bool = True             # restore latest checkpoint if present
+    eval_only: bool = False         # restore + evaluate, no training
     # multi-host (config 5)
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -138,6 +139,10 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--no-resume", dest="resume", action="store_false",
                    default=None)
+    p.add_argument("--eval-only", dest="eval_only", action="store_true",
+                   default=None,
+                   help="restore from --checkpoint-dir and evaluate; "
+                        "no training steps")
     p.add_argument("--coordinator-address", default=None)
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)

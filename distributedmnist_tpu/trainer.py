@@ -314,7 +314,10 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
                          "batches would reshard on every split)")
     data = data if data is not None else load_mnist(
         cfg.data_dir, cfg.synthetic, cfg.seed)
-    ds = DeviceDataset(data, mesh, device_resident_train=not streaming)
+    # Eval-only never touches train data: skip its device placement too.
+    ds = DeviceDataset(
+        data, mesh,
+        device_resident_train=not streaming and not cfg.eval_only)
 
     # TP shards whole params across 'model'; the Pallas kernel is written
     # for unsharded operands, so TP runs force the XLA dense path.
@@ -334,6 +337,12 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     # specs under TP (parallel/tp.py); the step function never changes.
     state = jax.device_put(state, tp.state_shardings(state, mesh, cfg.model))
 
+    if cfg.eval_only and not (cfg.checkpoint_dir and cfg.resume):
+        raise ValueError(
+            "--eval-only needs a restorable checkpoint "
+            "(--checkpoint-dir with an existing checkpoint and "
+            "resume enabled)")
+
     ckpt = None
     restored = False
     if cfg.checkpoint_dir:
@@ -344,7 +353,18 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
                 log.info("restored checkpoint at step %d", int(state.step))
 
     start_step = int(state.step)
-    if streaming:
+    if cfg.eval_only:
+        if not restored:
+            ckpt.close()   # don't leak the async manager on the error path
+            raise ValueError(
+                "--eval-only: no checkpoint found to restore in "
+                f"{cfg.checkpoint_dir!r}")
+        # Evaluate the restored state and skip the training loop: the
+        # loop below is a no-op when total_steps == start_step, and the
+        # summary's closing eval produces the accuracy.
+        total_steps = start_step
+        run_block = None       # never called: the loop body is unreachable
+    elif streaming:
         from distributedmnist_tpu.data.host_loader import HostStream
         stream = HostStream(data["train_x"], data["train_y"],
                             cfg.batch_size, cfg.seed, mesh,

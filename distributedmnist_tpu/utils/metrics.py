@@ -72,8 +72,13 @@ class StepTimer:
     def snapshot(self, sync: Any = None) -> dict:
         if sync is not None:
             self.barrier(sync)
-        elapsed = (time.perf_counter() - (self.t0 or time.perf_counter())
-                   - self.excluded)
+        # No window was ever opened (e.g. an eval-only run): report a zero
+        # window rather than `-excluded` (excluded spans can accrue from
+        # eval even when start() never ran).
+        if self.t0 is None:
+            elapsed = 0.0
+        else:
+            elapsed = time.perf_counter() - self.t0 - self.excluded
         images = self.steps * self.global_batch
         ips = images / elapsed if elapsed > 0 else 0.0
         return {

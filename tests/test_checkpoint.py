@@ -73,3 +73,29 @@ def test_max_to_keep_garbage_collects(tmp_path, eight_devices):
     steps = sorted(ckpt.mgr.all_steps())
     ckpt.close()
     assert steps == [3, 4]
+
+
+def test_eval_only_restores_and_reports(tmp_path, tiny_data):
+    from distributedmnist_tpu import trainer
+    from distributedmnist_tpu.config import Config
+    import pytest
+
+    cfg = Config(device="cpu", num_devices=8, synthetic=True, model="mlp",
+                 optimizer="sgd", learning_rate=0.05, fused_kernels="xla",
+                 batch_size=256, steps=20, eval_every=20, log_every=0,
+                 target_accuracy=None,
+                 checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=10)
+    trained = trainer.fit(cfg, data=tiny_data)
+
+    ev = trainer.fit(cfg.replace(eval_only=True), data=tiny_data)
+    assert ev["restored"] is True
+    assert ev["steps"] == 20                     # no training happened
+    np.testing.assert_allclose(ev["test_accuracy"],
+                               trained["test_accuracy"], atol=1e-6)
+    assert ev["final_loss"] is None              # no step ran
+
+    # eval-only without a checkpoint is an error, not a silent cold eval
+    with pytest.raises(ValueError, match="eval-only"):
+        trainer.fit(cfg.replace(eval_only=True,
+                                checkpoint_dir=str(tmp_path / "none")),
+                    data=tiny_data)
