@@ -135,7 +135,9 @@ def main(argv=None) -> int:
     mesh = make_mesh(devs)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
-    data = load_mnist(synthetic=True, seed=0)  # pixels identical cost to real
+    # --data-dir is honored (real pixels cost the same as synthetic ones,
+    # but silently dropping a user flag is worse than loading the data)
+    data = load_mnist(args.data_dir, synthetic=args.data_dir is None, seed=0)
     ds = DeviceDataset(data, mesh)
     model = models.build(args.model, dtype=dtype,
                          platform=devs[0].platform)
@@ -157,17 +159,25 @@ def main(argv=None) -> int:
 
     from distributedmnist_tpu.utils import StepTimer
 
+    last_mark = [time.monotonic()]
+
     def run(n_steps):
         """Run >= n_steps optimizer steps in blocks of spc; returns the
         exact step count executed."""
         metrics = None
         blocks = max(1, -(-n_steps // spc))
-        for _ in range(blocks):
+        for b in range(blocks):
             state_box[0], metrics = step_fn(state_box[0], ds.train_x,
                                             ds.train_y,
                                             stream.next_block(spc))
             if sync_every_step:
                 jax.block_until_ready(metrics["loss"])
+            # Periodic liveness for the supervisor: a legitimately long
+            # window (slow backend, big --bench-steps) must not read as a
+            # silent stall and get the healthy worker killed.
+            if time.monotonic() - last_mark[0] > 15:
+                _mark(f"block {b + 1}/{blocks}")
+                last_mark[0] = time.monotonic()
         # The clock stops on a device->host VALUE fetch of the final
         # block's loss: its dependency chain covers every queued block,
         # and on pooled/tunneled backends block_until_ready can return
@@ -203,6 +213,7 @@ def main(argv=None) -> int:
         "vs_baseline": round(value / TARGET_IPS_PER_CHIP, 3),
         "detail": {
             "model": args.model,
+            "data": ds.source,
             "global_batch": gb,
             "n_chips": n_chips,
             "backend": devs[0].platform,

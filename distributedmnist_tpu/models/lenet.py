@@ -35,13 +35,20 @@ class LeNet5(nn.Module):
                 return PatchConv(feat, (5, 5), padding=padding,
                                  dtype=self.dtype, name=name)
             pool = avg_pool2
-        else:
+        elif self.conv_impl == "lax":
             def conv(feat, padding, name):
                 return nn.Conv(feat, (5, 5), padding=padding,
                                dtype=self.dtype, name=name)
 
             def pool(x):
                 return nn.avg_pool(x, (2, 2), strides=(2, 2))
+        else:
+            # A typo must fail loudly: silently taking the lax path would
+            # hang forever on platforms whose conv backward can't compile
+            # (the reason the im2col path exists — ops/conv.py).
+            raise ValueError(
+                f"unknown conv_impl {self.conv_impl!r} "
+                "(expected 'im2col' or 'lax')")
         x = x.astype(self.dtype)                       # (B, 28, 28, 1)
         x = conv(6, "SAME", "conv1")(x)                # (B, 28, 28, 6)
         x = nn.relu(x)

@@ -85,6 +85,10 @@ def run_supervised(script: str, argv: list[str],
 
         stalled = False
         teardown_grace = min(30.0, stall_timeout)
+        # Hard per-attempt ceiling: a wedged worker that emits periodic
+        # chatter (retry warnings, reconnect spam) never goes quiet, so
+        # silence alone cannot bound the attempt.
+        deadline = time.monotonic() + max(20 * stall_timeout, 1800.0)
         while proc.poll() is None:
             quiet = time.monotonic() - last[0]
             if accept(out_lines) is not None and quiet > teardown_grace:
@@ -93,6 +97,11 @@ def run_supervised(script: str, argv: list[str],
                 break
             if quiet > stall_timeout:
                 stalled = True
+                stall_reason = f"no output for {stall_timeout:.0f}s"
+                break
+            if time.monotonic() > deadline:
+                stalled = True
+                stall_reason = "attempt deadline exceeded"
                 break
             time.sleep(1)
 
@@ -110,8 +119,7 @@ def run_supervised(script: str, argv: list[str],
             sys.stdout.write(result)
             sys.stdout.flush()
             return 0
-        reason = (f"no output for {stall_timeout:.0f}s" if stalled
-                  else f"exit code {proc.returncode}")
+        reason = stall_reason if stalled else f"exit code {proc.returncode}"
         mark(f"worker failed ({reason}), attempt {attempt}/{total}")
     mark("all attempts failed")
     return 1

@@ -81,6 +81,15 @@ def test_avg_pool2_matches_nn_avg_pool():
                                rtol=1e-6, atol=1e-6)
 
 
+def test_bad_conv_impl_raises():
+    from distributedmnist_tpu.models import LeNet5
+    with pytest.raises(ValueError, match="conv_impl"):
+        LeNet5(conv_impl="im2coll").init(   # typo must not fall back to lax
+            jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    with pytest.raises(ValueError, match="conv impl"):
+        models.build("lenet", conv="patch")
+
+
 def test_im2col_trains_e2e(tiny_data):
     from distributedmnist_tpu import trainer
     from distributedmnist_tpu.config import Config
