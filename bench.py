@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Benchmark, printed as ONE JSON line. Two modes for the two halves of
-the BASELINE metric ("MNIST images/sec/chip; wall-clock to 99% test
-accuracy"):
+"""Benchmark, printed as ONE JSON line. Four modes; the first two are the
+two halves of the BASELINE metric ("MNIST images/sec/chip; wall-clock to
+99% test accuracy"):
 
 - throughput (default): steady-state training images/sec/chip on the
   LeNet-5 data-parallel workload [config 4: global batch 512]. The full
@@ -10,7 +10,15 @@ accuracy"):
   backend (the real TPU chip under the driver).
 - time-to-accuracy: wall-clock seconds for a full training run to reach
   --target-accuracy (train + eval, compile excluded from neither — this is
-  the end-to-end number a user experiences).
+  the end-to-end number a user experiences). Repeated --trials, median.
+- sweep: img/s/chip at several per-chip batch sizes. The small-batch end
+  is the 8-chip regime (global batch 512 on 8 chips = 64 rows/chip), so a
+  1-chip sweep plus a psum-cost estimate yields the quantitative 8-chip
+  scaling argument recorded in BASELINE.md.
+- smoke: one supervised end-to-end gate on the default backend — train a
+  few scanned blocks, eval, checkpoint save, then restore+resume in the
+  same process; JSON verdict. Cheap enough to run every round; catches
+  TPU-path regressions the CPU test suite can't.
 
 The measurement runs in a supervised worker subprocess: TPU runtime claims
 through tunneled/pooled backends can wedge forever before the first
@@ -45,20 +53,49 @@ def _mark(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
+def _barrier_marked(sync, every: float = 15.0) -> None:
+    """StepTimer.barrier with liveness marks emitted every `every` seconds
+    from a helper thread while the device->host fetch is in flight."""
+    import threading
+
+    from distributedmnist_tpu.utils import StepTimer
+
+    done = threading.Event()
+
+    def beat():
+        t0 = time.monotonic()
+        while not done.wait(every):
+            _mark(f"waiting on device ({time.monotonic() - t0:.0f}s)")
+
+    t = threading.Thread(target=beat, daemon=True)
+    t.start()
+    try:
+        StepTimer.barrier(sync)
+    finally:
+        done.set()
+        t.join(timeout=5)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["throughput", "time-to-accuracy"],
+    p.add_argument("--mode",
+                   choices=["throughput", "time-to-accuracy", "sweep",
+                            "smoke"],
                    default="throughput")
     p.add_argument("--target-accuracy", type=float, default=0.99)
     p.add_argument("--data-dir", default=None,
                    help="real MNIST IDX/npz dir; synthetic fallback")
     p.add_argument("--max-epochs", type=int, default=20)
-    p.add_argument("--global-batch", type=int, default=512)
+    p.add_argument("--global-batch", type=int, default=None,
+                   help="global batch (default 512; sweep mode rejects "
+                        "this — it takes --sweep-batches)")
     p.add_argument("--warmup-steps", type=int, default=None,
-                   help="[throughput] compile/warmup steps (default 20)")
+                   help="[throughput/sweep] compile/warmup steps "
+                        "(default 20)")
     p.add_argument("--bench-steps", type=int, default=None,
-                   help="[throughput] timed steps, >= 1 "
-                        "(default: 2048 on tpu, 64 on cpu)")
+                   help="[throughput/sweep] timed steps, >= 1 "
+                        "(default: 4096 on tpu, 64 on cpu; sweep scales "
+                        "the count down with the batch size)")
     p.add_argument("--steps-per-call", type=int, default=None,
                    help="optimizer steps fused per dispatch via lax.scan "
                         "(default: 1 on cpu; on tpu 256 in throughput mode, "
@@ -67,8 +104,13 @@ def main(argv=None) -> int:
     p.add_argument("--model", default="lenet")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--repeats", type=int, default=None,
-                   help="[throughput] timed windows, median reported "
+                   help="[throughput/sweep] timed windows, median reported "
                         "(default: 3 on tpu, 1 on cpu)")
+    p.add_argument("--trials", type=int, default=None,
+                   help="[time-to-accuracy] full training runs, median "
+                        "reported (default: 3 on tpu, 1 on cpu)")
+    p.add_argument("--sweep-batches", default="64,128,256,512",
+                   help="[sweep] comma-separated per-chip batch sizes")
     p.add_argument("--stall-timeout", type=float, default=300.0,
                    help="kill+retry the worker if it is silent this long")
     p.add_argument("--max-attempts", type=int, default=3,
@@ -79,15 +121,10 @@ def main(argv=None) -> int:
 
     # Cheap arg-only validation FIRST: a deterministic usage error must
     # exit 2 immediately, not be retried in supervised subprocesses.
-    if args.mode == "time-to-accuracy":
-        # throughput-only knobs are rejected, not silently ignored
-        # (--warmup-steps especially would read as LR warmup here)
-        if (args.warmup_steps is not None or args.bench_steps is not None
-                or args.repeats is not None):
-            p.error("--warmup-steps/--bench-steps/--repeats are "
-                    "throughput-mode flags; time-to-accuracy takes "
-                    "--max-epochs and --steps-per-call")
-    else:
+    if args.mode in ("throughput", "sweep"):
+        if args.trials is not None:
+            p.error("--trials is a time-to-accuracy flag; throughput/"
+                    "sweep take --repeats")
         args.warmup_steps = (20 if args.warmup_steps is None
                              else args.warmup_steps)
         # bench_steps default is platform-dependent; resolved in the
@@ -96,6 +133,39 @@ def main(argv=None) -> int:
             p.error("--bench-steps must be >= 1")
         if args.repeats is not None and args.repeats < 1:
             p.error("--repeats must be >= 1")
+        if args.mode == "sweep":
+            if args.global_batch is not None:
+                p.error("--global-batch is meaningless in sweep mode "
+                        "(the curve comes from --sweep-batches); "
+                        "rejected rather than silently ignored")
+            try:
+                args.sweep_batches = sorted(
+                    {int(b) for b in args.sweep_batches.split(",")})
+            except ValueError:
+                p.error("--sweep-batches must be comma-separated ints")
+            if not args.sweep_batches or args.sweep_batches[0] < 1:
+                p.error("--sweep-batches must be positive")
+    elif args.mode == "smoke":
+        # smoke is a fixed-shape gate; measurement knobs are rejected,
+        # not silently ignored (same principle as the other modes).
+        if (args.warmup_steps is not None or args.bench_steps is not None
+                or args.repeats is not None or args.trials is not None
+                or args.steps_per_call is not None):
+            p.error("smoke mode takes only --model/--dtype/--data-dir/"
+                    "--global-batch; measurement flags belong to "
+                    "throughput/sweep/time-to-accuracy")
+    elif args.mode == "time-to-accuracy":
+        # throughput-only knobs are rejected, not silently ignored
+        # (--warmup-steps especially would read as LR warmup here)
+        if (args.warmup_steps is not None or args.bench_steps is not None
+                or args.repeats is not None):
+            p.error("--warmup-steps/--bench-steps/--repeats are "
+                    "throughput-mode flags; time-to-accuracy takes "
+                    "--max-epochs, --trials and --steps-per-call")
+        if args.trials is not None and args.trials < 1:
+            p.error("--trials must be >= 1")
+    if args.global_batch is None:
+        args.global_batch = 512
 
     from distributedmnist_tpu.utils import supervise
 
@@ -115,97 +185,144 @@ def main(argv=None) -> int:
                           "PALLAS_AXON_POOL_IPS": None})
     if args.mode == "time-to-accuracy":
         return _time_to_accuracy(args)
+    if args.mode == "smoke":
+        return _smoke(args)
+    if args.mode == "sweep":
+        return _sweep(args)
+    return _throughput(args)
 
-    import jax
-    import jax.numpy as jnp
 
-    from distributedmnist_tpu import models, optim
-    from distributedmnist_tpu.data import load_mnist
-    from distributedmnist_tpu.data.loader import DeviceDataset, IndexStream
-    from distributedmnist_tpu.parallel import make_mesh, replicated
-    from distributedmnist_tpu.trainer import init_state, make_train_step
+class _Runner:
+    """Shared backend/data/model setup + per-batch-size throughput
+    measurement for the throughput and sweep modes."""
 
-    from distributedmnist_tpu.utils import enable_compilation_cache, round_up
+    def __init__(self, args):
+        import jax
+        import jax.numpy as jnp
 
-    enable_compilation_cache()
-    devs = jax.devices()
-    _mark(f"backend up: {len(devs)}x {devs[0].platform}")
-    n_chips = len(devs)
-    gb = round_up(args.global_batch, n_chips)
-    mesh = make_mesh(devs)
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+        from distributedmnist_tpu import models
+        from distributedmnist_tpu.data import load_mnist
+        from distributedmnist_tpu.data.loader import DeviceDataset
+        from distributedmnist_tpu.parallel import make_mesh
+        from distributedmnist_tpu.utils import enable_compilation_cache
 
-    # --data-dir is honored (real pixels cost the same as synthetic ones,
-    # but silently dropping a user flag is worse than loading the data)
-    data = load_mnist(args.data_dir, synthetic=args.data_dir is None, seed=0)
-    ds = DeviceDataset(data, mesh)
-    model = models.build(args.model, dtype=dtype,
-                         platform=devs[0].platform)
-    tx = optim.build("adam", 1e-3)
-    state = jax.device_put(
-        init_state(jax.random.PRNGKey(0), model, tx,
-                   jnp.zeros((1, 28, 28, 1))),
-        replicated(mesh))
-    step_fn = make_train_step(model, tx, mesh, mode="auto", dtype=dtype)
-    stream = IndexStream(ds.train_n, gb, seed=0, mesh=mesh)
+        enable_compilation_cache()
+        self.devs = jax.devices()
+        _mark(f"backend up: {len(self.devs)}x {self.devs[0].platform}")
+        self.n_chips = len(self.devs)
+        self.mesh = make_mesh(self.devs)
+        self.dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                      else jnp.float32)
+        # --data-dir is honored (real pixels cost the same as synthetic
+        # ones, but silently dropping a user flag is worse than loading)
+        data = load_mnist(args.data_dir, synthetic=args.data_dir is None,
+                          seed=0)
+        # Production defaults: packed pixel rows + flat optimizer update
+        # (config.py pixel_format/flat_optimizer) — what fit() runs.
+        self.ds = DeviceDataset(data, self.mesh, pixel_format="packed")
+        self.model = models.build(args.model, dtype=self.dtype,
+                                  platform=self.devs[0].platform)
+        # CPU's collective rendezvous deadlocks under concurrent in-flight
+        # programs (small host thread pool); TPU pipelines safely.
+        self.sync_every_step = self.devs[0].platform == "cpu"
 
-    # CPU's collective rendezvous deadlocks under concurrent in-flight
-    # programs (small host thread pool); TPU pipelines safely.
-    sync_every_step = devs[0].platform == "cpu"
-    spc = (max(1, args.steps_per_call) if args.steps_per_call is not None
-           else (1 if sync_every_step else 256))
+    def measure(self, args, gb: int, bench_steps: int) -> dict:
+        """Median img/s/chip over repeated timed windows at global batch
+        gb. Fresh state per call so every batch size starts identically."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributedmnist_tpu import optim
+        from distributedmnist_tpu.data.loader import IndexStream
+        from distributedmnist_tpu.parallel import replicated
+        from distributedmnist_tpu.trainer import (init_state,
+                                                  make_train_step)
+
+        tx = optim.build("adam", 1e-3, flat=True)
+        state = jax.device_put(
+            init_state(jax.random.PRNGKey(0), self.model, tx,
+                       jnp.zeros((1, 28, 28, 1))),
+            replicated(self.mesh))
+        step_fn = make_train_step(self.model, tx, self.mesh, mode="auto",
+                                  dtype=self.dtype,
+                                  pixel_format="packed")
+        stream = IndexStream(self.ds.train_n, gb, seed=0, mesh=self.mesh)
+        spc = (max(1, args.steps_per_call)
+               if args.steps_per_call is not None
+               else (1 if self.sync_every_step else 256))
+
+        state_box = [state]
+
+        last_mark = [time.monotonic()]
+
+        def run(n_steps):
+            """Run >= n_steps optimizer steps in blocks of spc; returns
+            the exact step count executed."""
+            metrics = None
+            blocks = max(1, -(-n_steps // spc))
+            for b in range(blocks):
+                state_box[0], metrics = step_fn(
+                    state_box[0], self.ds.train_x, self.ds.train_y,
+                    stream.next_block(spc))
+                if self.sync_every_step:
+                    jax.block_until_ready(metrics["loss"])
+                # On the synchronous CPU path the wall-clock lives in
+                # THIS loop (a window takes minutes), so liveness marks
+                # must come from here too or the supervisor reads the
+                # silence as a stall and kills a healthy worker.
+                if time.monotonic() - last_mark[0] > 15:
+                    _mark(f"block {b + 1}/{blocks}")
+                    last_mark[0] = time.monotonic()
+            # The clock stops on a device->host VALUE fetch of the final
+            # block's loss: its dependency chain covers every queued
+            # block, and on pooled/tunneled backends block_until_ready
+            # can return before execution completes (StepTimer.barrier) —
+            # fetched bytes are the only proof the work happened. On TPU
+            # dispatch is async and finishes in milliseconds, so the
+            # wall-clock lives in THIS wait — _barrier_marked emits
+            # liveness from a helper thread while it blocks.
+            _barrier_marked(metrics["loss"])
+            return blocks * spc
+
+        _mark(f"b={gb}: compiling + warmup")
+        run(args.warmup_steps)
+        # Repeated timed windows, median reported: run-to-run variance on
+        # a tunneled/pooled backend is substantial, and one window would
+        # make the recorded number a lottery. 1 repeat on CPU (each
+        # window is minutes there).
+        repeats = args.repeats if args.repeats is not None \
+            else (1 if self.sync_every_step else 3)
+        windows = []
+        n_run = 0
+        for r in range(repeats):
+            t0 = time.perf_counter()
+            n_run = run(bench_steps)
+            windows.append(n_run * gb
+                           / (time.perf_counter() - t0) / self.n_chips)
+            _mark(f"b={gb} window {r + 1}/{repeats}: "
+                  f"{windows[-1]:.0f} img/s/chip")
+
+        import statistics
+        value = statistics.median(windows)
+        return {"img_s_chip": value, "windows": windows,
+                "bench_steps": n_run, "steps_per_call": spc,
+                "step_ms": (1000 * gb / value / self.n_chips
+                            if value else None)}
+
+
+def _throughput(args) -> int:
+    from distributedmnist_tpu.utils import round_up
+
+    r = _Runner(args)
+    gb = round_up(args.global_batch, r.n_chips)
+    # 4096-step windows amortize the closing value fetch (~140 ms on the
+    # relay) to <0.04 ms/step; production fit() drains its bounded
+    # inflight window via one fetch per 4096 steps too, so this is still
+    # conservative relative to a real training run.
     if args.bench_steps is None:
-        args.bench_steps = 64 if sync_every_step else 2048
-
-    from distributedmnist_tpu.utils import StepTimer
-
-    last_mark = [time.monotonic()]
-
-    def run(n_steps):
-        """Run >= n_steps optimizer steps in blocks of spc; returns the
-        exact step count executed."""
-        metrics = None
-        blocks = max(1, -(-n_steps // spc))
-        for b in range(blocks):
-            state_box[0], metrics = step_fn(state_box[0], ds.train_x,
-                                            ds.train_y,
-                                            stream.next_block(spc))
-            if sync_every_step:
-                jax.block_until_ready(metrics["loss"])
-            # Periodic liveness for the supervisor: a legitimately long
-            # window (slow backend, big --bench-steps) must not read as a
-            # silent stall and get the healthy worker killed.
-            if time.monotonic() - last_mark[0] > 15:
-                _mark(f"block {b + 1}/{blocks}")
-                last_mark[0] = time.monotonic()
-        # The clock stops on a device->host VALUE fetch of the final
-        # block's loss: its dependency chain covers every queued block,
-        # and on pooled/tunneled backends block_until_ready can return
-        # before execution completes (StepTimer.barrier) — fetched bytes
-        # are the only proof the work happened.
-        StepTimer.barrier(metrics["loss"])
-        return blocks * spc
-
-    state_box = [state]
-    _mark("state initialized; compiling + warmup")
-    run(args.warmup_steps)
-    _mark("warmup done; timing")
-    # Repeated timed windows, median reported: run-to-run variance on a
-    # tunneled/pooled backend is substantial, and one window would make
-    # the recorded number a lottery. 1 repeat on CPU (each window is
-    # minutes there).
-    repeats = args.repeats if args.repeats is not None \
-        else (1 if sync_every_step else 3)
-    windows = []
-    n_run = 0
-    for r in range(repeats):
-        t0 = time.perf_counter()
-        n_run = run(args.bench_steps)
-        windows.append(n_run * gb / (time.perf_counter() - t0) / n_chips)
-        _mark(f"window {r + 1}/{repeats}: {windows[-1]:.0f} img/s/chip")
-
-    import statistics
-    value = statistics.median(windows)
+        args.bench_steps = 64 if r.sync_every_step else 4096
+    m = r.measure(args, gb, args.bench_steps)
+    value = m["img_s_chip"]
     print(json.dumps({
         "metric": "train_images_per_sec_per_chip",
         "value": round(value, 1),
@@ -213,16 +330,162 @@ def main(argv=None) -> int:
         "vs_baseline": round(value / TARGET_IPS_PER_CHIP, 3),
         "detail": {
             "model": args.model,
-            "data": ds.source,
+            "data": r.ds.source,
             "global_batch": gb,
-            "n_chips": n_chips,
-            "backend": devs[0].platform,
+            "n_chips": r.n_chips,
+            "backend": r.devs[0].platform,
             "dtype": args.dtype,
-            "bench_steps": n_run,
-            "steps_per_call": spc,
-            "step_ms": round(1000 * gb / value / n_chips, 3) if value
-            else None,
-            "windows_img_s_chip": [round(w, 1) for w in windows],
+            "bench_steps": m["bench_steps"],
+            "steps_per_call": m["steps_per_call"],
+            "step_ms": (round(m["step_ms"], 3)
+                        if m["step_ms"] is not None else None),
+            "windows_img_s_chip": [round(w, 1) for w in m["windows"]],
+        },
+    }))
+    return 0
+
+
+def _sweep(args) -> int:
+    """Batch sweep + the 8-chip scaling estimate (BASELINE.md 'Scaling').
+
+    Per-chip batch b on 1 chip is compute-identical to global batch
+    8b on 8 chips; the only extra 8-chip cost is the gradient allreduce
+    over ICI. predicted-8-chip img/s/chip at global 512 = measured
+    img/s/chip at b=64, discounted by the modeled allreduce time.
+    """
+    r = _Runner(args)
+    if args.bench_steps is None:
+        args.bench_steps = 64 if r.sync_every_step else 4096
+    curve = {}
+    for b in args.sweep_batches:
+        # b is the PER-CHIP batch; the measured global batch scales with
+        # the visible chips so the curve means the same thing on a 1-chip
+        # and an 8-chip host. A CONSTANT step count per batch size keeps
+        # the closing value fetch identically amortized across the curve
+        # (fewer steps at small b would inflate exactly the small-batch
+        # step_ms the strong-scaling prediction is computed from).
+        gb = b * r.n_chips
+        m = r.measure(args, gb, args.bench_steps)
+        curve[b] = {"img_s_chip": round(m["img_s_chip"], 1),
+                    "step_ms": round(m["step_ms"], 4)}
+
+    # Gradient allreduce cost model (f32 grads, ring allreduce over ICI):
+    # bytes on the wire per chip ~= 2 * grad_bytes * (n-1)/n.
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import optim
+    from distributedmnist_tpu.trainer import init_state
+    # Param count via eval_shape: no device work mid-benchmark.
+    state_shape = jax.eval_shape(
+        lambda k: init_state(k, r.model, optim.build("adam", 1e-3),
+                             jnp.zeros((1, 28, 28, 1))),
+        jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(state_shape.params))
+    grad_bytes = n_params * 4
+    ici_gbps = 45.0   # conservative v5e ICI per-link bandwidth (GB/s)
+    allreduce_ms = 2 * grad_bytes * (8 - 1) / 8 / (ici_gbps * 1e9) * 1e3
+    # When the benchmark host itself has >1 chip, the measured step
+    # ALREADY contains the real XLA-inserted allreduce — adding the
+    # model on top would double-count it. The model term only bridges a
+    # 1-chip measurement to the 8-chip prediction.
+    modeled_ms = allreduce_ms if r.n_chips == 1 else 0.0
+    # Strong scaling: global batch fixed at 8x the SMALLEST per-chip
+    # batch (config 4's global 512 = 64/chip on 8 chips) — the per-chip
+    # step is overhead-dominated there, so speedup is sub-linear.
+    smallest, largest = min(curve), max(curve)
+    strong_step_ms = curve[smallest]["step_ms"] + modeled_ms
+    strong_img_s_chip = smallest / strong_step_ms * 1e3
+    # Weak scaling: per-chip batch held at the LARGEST measured size; the
+    # only 8-chip overhead is the allreduce, so efficiency is near 1 —
+    # the north_star's "near-linear images/sec scaling to 8 chips".
+    weak_step_ms = curve[largest]["step_ms"] + modeled_ms
+    weak_img_s_chip = largest / weak_step_ms * 1e3
+    weak_eff = weak_img_s_chip / curve[largest]["img_s_chip"]
+    value = strong_img_s_chip
+    print(json.dumps({
+        "metric": "predicted_8chip_images_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / TARGET_IPS_PER_CHIP, 3),
+        "detail": {
+            "model": args.model,
+            "backend": r.devs[0].platform,
+            "dtype": args.dtype,
+            "n_chips_measured": r.n_chips,
+            "curve_img_s_chip": {str(k): v for k, v in curve.items()},
+            "n_params": n_params,
+            "grad_bytes_f32": grad_bytes,
+            "ici_assumed_gbps": ici_gbps,
+            "allreduce_ms_est": round(allreduce_ms, 4),
+            "allreduce_modeled": r.n_chips == 1,
+            "strong_scaling": {
+                "per_chip_batch": smallest,
+                "global_batch_8chip": 8 * smallest,
+                "step_ms": round(strong_step_ms, 4),
+                "img_s_chip": round(strong_img_s_chip, 1),
+                "global_img_s": round(8 * strong_img_s_chip, 1),
+            },
+            "weak_scaling": {
+                "per_chip_batch": largest,
+                "global_batch_8chip": 8 * largest,
+                "step_ms": round(weak_step_ms, 4),
+                "img_s_chip": round(weak_img_s_chip, 1),
+                "global_img_s": round(8 * weak_img_s_chip, 1),
+                "efficiency_vs_1chip": round(weak_eff, 4),
+            },
+        },
+    }))
+    return 0
+
+
+def _smoke(args) -> int:
+    """End-to-end gate on the default backend: train + eval + checkpoint
+    save, then restore + resume. One JSON verdict line."""
+    import logging
+    import tempfile
+
+    import jax
+
+    from distributedmnist_tpu import trainer
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.utils import round_up
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    devs = jax.devices()
+    _mark(f"backend up: {len(devs)} devices")
+    gb = round_up(min(args.global_batch, 256), len(devs))
+    legs = []
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        cfg = Config(model=args.model, optimizer="adam",
+                     learning_rate=1e-3, synthetic=args.data_dir is None,
+                     data_dir=args.data_dir, batch_size=gb,
+                     steps=64, eval_every=32, log_every=0,
+                     target_accuracy=None, checkpoint_dir=ckpt_dir,
+                     checkpoint_every=32, dtype=args.dtype)
+        out1 = trainer.fit(cfg)
+        assert out1["steps"] == 64, out1
+        legs += ["train", "eval", "checkpoint-save"]
+        _mark("first run done; restoring + resuming")
+        out2 = trainer.fit(cfg.replace(steps=96))
+        assert out2["restored"] is True, out2
+        assert out2["steps"] == 96, out2
+        legs.append("restore-resume")
+    print(json.dumps({
+        "metric": "tpu_smoke",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "detail": {
+            "backend": devs[0].platform,
+            "n_chips": len(devs),
+            "model": args.model,
+            "legs": legs,
+            "final_accuracy": round(out2["test_accuracy"], 4),
+            # out1's number: the resume run fits in a single dispatch
+            # block, which never opens a throughput window.
+            "images_per_sec_per_chip":
+                round(out1["images_per_sec_per_chip"], 1),
         },
     }))
     return 0
@@ -230,6 +493,7 @@ def main(argv=None) -> int:
 
 def _time_to_accuracy(args) -> int:
     import logging
+    import statistics
 
     import jax
 
@@ -241,7 +505,8 @@ def _time_to_accuracy(args) -> int:
     # signal (and give the driver progress visibility).
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
-    n_chips = len(jax.devices())
+    devs = jax.devices()
+    n_chips = len(devs)
     _mark(f"backend up: {n_chips} devices")
     gb = round_up(args.global_batch, n_chips)
     cfg = Config(model=args.model, optimizer="adam", learning_rate=2e-3,
@@ -249,35 +514,62 @@ def _time_to_accuracy(args) -> int:
                  data_dir=args.data_dir, synthetic=args.data_dir is None,
                  batch_size=gb,
                  epochs=args.max_epochs,
-                 eval_every=100, log_every=0,
+                 # ~1.7 epochs between evals at b=512: each eval costs a
+                 # full device->host fetch (~140 ms on the relay), and the
+                 # calibrated task crosses 99% around epoch 6-8, so a
+                 # 100-step cadence would spend more on evals than train.
+                 eval_every=200, log_every=0,
                  target_accuracy=args.target_accuracy,
                  steps_per_call=args.steps_per_call,
                  dtype=args.dtype)
-    out = trainer.fit(cfg)
-    wall = out["wall_clock_to_target_s"]
-    reached = wall is not None
-    # Both outcomes report fit()'s own training clock so the two numbers
-    # span the same interval (a missed run must not look slower merely by
-    # charging data-load/model-init setup that a reached run never pays).
-    value = wall if reached else out["wall_clock_s"]
-    # vs_baseline only counts when the accuracy half of the target was met;
-    # a fast run that never reached target is a miss (0.0), not a win.
-    vs = round(TARGET_WALL_S / value, 3) if (reached and value) else 0.0
+    # Repeated full trials, median reported: a single run's wall-clock has
+    # multi-x run-to-run spread on a tunneled backend (relay latency), so
+    # one sample would make the recorded number a lottery. Trial 1 pays
+    # compile (persistent-cache warm at best); later trials additionally
+    # hit the in-process executable cache — the spread in detail.trials_s
+    # is the honest picture. 1 trial on CPU (each is minutes).
+    trials = args.trials if args.trials is not None \
+        else (3 if devs[0].platform != "cpu" else 1)
+    walls, reached_flags, finals, steps_list = [], [], [], []
+    for t in range(trials):
+        out = trainer.fit(cfg)
+        wall = out["wall_clock_to_target_s"]
+        reached = wall is not None
+        # Both outcomes report fit()'s own training clock so the two
+        # numbers span the same interval (a missed run must not look
+        # slower merely by charging data-load/model-init setup that a
+        # reached run never pays).
+        walls.append(wall if reached else out["wall_clock_s"])
+        reached_flags.append(reached)
+        finals.append(out["test_accuracy"])
+        steps_list.append(out["steps"])
+        _mark(f"trial {t + 1}/{trials}: {walls[-1]:.2f}s "
+              f"(reached={reached})")
+    value = statistics.median(walls)
+    all_reached = all(reached_flags)
+    # vs_baseline only counts when the accuracy half of the target was met
+    # in EVERY trial; a fast run that never reached target is a miss
+    # (0.0), not a win.
+    vs = round(TARGET_WALL_S / value, 3) if (all_reached and value) else 0.0
     print(json.dumps({
         "metric": "wall_clock_to_target_accuracy",
         "value": round(value, 2),
         "unit": "seconds",
         "vs_baseline": vs,
         "detail": {
-            "reached_target": reached,
+            "reached_target": all_reached,
             "target_accuracy": args.target_accuracy,
-            "final_accuracy": round(out["test_accuracy"], 4),
-            "steps": out["steps"],
+            "trials": trials,
+            "trials_s": [round(w, 2) for w in walls],
+            "min_s": round(min(walls), 2),
+            "max_s": round(max(walls), 2),
+            "final_accuracy": round(finals[-1], 4),
+            "steps": steps_list[-1],
             "data": out["data"],
             "model": args.model,
             "global_batch": out["global_batch"],
             "n_chips": n_chips,
-            "backend": jax.devices()[0].platform,
+            "backend": devs[0].platform,
             "dtype": args.dtype,
         },
     }))

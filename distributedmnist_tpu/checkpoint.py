@@ -50,8 +50,24 @@ class Checkpointer:
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=x.sharding), state)
-        restored = self.mgr.restore(step,
-                                    args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self.mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        except (ValueError, TypeError, KeyError) as e:
+            # Structure mismatches surface as ValueError/TypeError/
+            # KeyError from orbax's tree handling (IO failures — a
+            # half-written directory, permissions — raise OSError and
+            # pass through untouched). The most common cause: the
+            # checkpoint was written with the other optimizer-state
+            # layout (flat single-vector vs per-leaf —
+            # config.flat_optimizer changed its default in round 2).
+            # Surface the knob instead of an opaque pytree error.
+            raise ValueError(
+                f"checkpoint at step {step} in {self.directory!r} does "
+                "not match this run's training-state structure. If it "
+                "was written by a run with the other optimizer layout, "
+                "retry with --no-flat-optimizer (or its inverse); "
+                f"original error: {e}") from e
         return restored, True
 
     def wait(self) -> None:

@@ -35,6 +35,16 @@ class Config:
     # MNIST-optimal default). "stream": per-host streaming batches for
     # datasets that outgrow HBM (data/host_loader.py). Same batch order.
     data_pipeline: str = "device"
+    # host-gather backend of the streaming pipeline: "numpy" (per-device
+    # row gathers, multi-host-scalable) or "tfdata" (tf.data pipeline
+    # with background prefetch — the north_star's literal per-host
+    # tf.data loader). Identical batch order (equivalence-tested).
+    stream_source: str = "numpy"
+    # device-resident train-set layout: "packed" stores 4 uint8 pixels
+    # per int32 word, making the per-step on-device row gather ~free
+    # (vs ~0.11 ms/step for uint8 rows at batch 512 — data/packing.py);
+    # "u8" keeps raw bytes. Bit-identical pixels and trajectories.
+    pixel_format: str = "packed"
     # schedule
     epochs: int = 10
     steps: Optional[int] = None     # overrides epochs when set
@@ -80,6 +90,13 @@ class Config:
     fused_kernels: str = "auto"     # {auto, pallas, xla}: pallas fused MLP layer
     conv_impl: str = "auto"         # {auto, im2col, lax}: LeNet conv path
                                     # (auto: patch-matmul on TPU, lax on CPU)
+    # Flatten params/grads/moments into one contiguous vector inside the
+    # optimizer update (optax.flatten): one fused elementwise update over
+    # 61k/101k params instead of dozens of tiny per-leaf ops — measured
+    # 0.15 ms/step faster at batch 512 (scripts/profile_step.py).
+    # Bit-identical trajectories. Auto-disabled under model_parallel > 1
+    # (TP shards optimizer moments by leaf name; a flat vector can't be).
+    flat_optimizer: bool = True
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -123,6 +140,10 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--synthetic", action="store_true", default=None)
     p.add_argument("--data-pipeline", choices=["device", "stream"],
                    default=None)
+    p.add_argument("--stream-source", choices=["numpy", "tfdata"],
+                   default=None)
+    p.add_argument("--pixel-format", choices=["packed", "u8"],
+                   default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--steps", type=int, default=None)
@@ -155,6 +176,15 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--grad-accum", type=int, default=None,
                    help="microbatches accumulated per optimizer step")
+    p.add_argument("--no-flat-optimizer", dest="flat_optimizer",
+                   action="store_false", default=None,
+                   help="per-leaf optimizer update instead of the fused "
+                        "flat-vector update")
+    p.add_argument("--flat-optimizer", dest="flat_optimizer",
+                   action="store_true", default=None,
+                   help="force the fused flat-vector update (the default; "
+                        "the explicit flag exists to restore checkpoints "
+                        "written with it after --no-flat-optimizer runs)")
     return p
 
 

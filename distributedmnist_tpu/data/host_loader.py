@@ -27,35 +27,92 @@ from distributedmnist_tpu.data.loader import IndexStream
 
 class HostStream:
     """Yields (x_block, y_block) device arrays of shape (K, B, ...) with
-    the batch axis sharded over 'data'."""
+    the batch axis sharded over 'data'.
+
+    Two host-gather backends (identical batch order, equivalence-tested):
+
+    - 'numpy' (default): the device placement callback gathers rows
+      directly from the numpy arrays, per device shard.
+    - 'tfdata': blocks flow through a tf.data pipeline (tf.gather mapped
+      over the index blocks, prefetch(2)) — the literal "per-host tf.data
+      pipeline feeding device-sharded global batches" named in
+      BASELINE.json's north_star. The pipeline's background threads
+      overlap block k+1's host gather with block k's device compute.
+      tf.data materializes the whole (k, B, ...) block on the host, so
+      the numpy backend remains the one that scales to multi-host
+      datasets where no process may hold a full global batch.
+    """
 
     def __init__(self, train_x: np.ndarray, train_y: np.ndarray,
                  global_batch: int, seed: int, mesh: Mesh,
-                 start_step: int = 0):
+                 start_step: int = 0, source: str = "numpy"):
+        if source not in ("numpy", "tfdata"):
+            raise ValueError(f"unknown host-stream source {source!r} "
+                             "(expected 'numpy' or 'tfdata')")
         self.train_x = train_x
         self.train_y = train_y
         self.mesh = mesh
+        self.source = source
         # Reuse IndexStream's seeded epoch-permutation math so batch order
         # matches the device-resident pipeline exactly.
         self.indices = IndexStream(train_x.shape[0], global_batch, seed,
                                    mesh, start_step=start_step)
+        self._tf_iter = None        # lazy (tfdata): (block_k, iterator)
 
     @property
     def step(self) -> int:
         return self.indices.step
 
-    def next_block(self, k: int):
+    def _put(self, idx: np.ndarray, x_host, y_host):
         import jax
-        idx = self.indices.host_block(k)
+        sharding = NamedSharding(self.mesh, P(None, "data"))
 
-        def put(arr):
-            # Per-device callback: each device (and therefore each process)
-            # gathers ONLY the rows of its own 'data' slice — no process
-            # ever materializes the full global batch on the host, which is
-            # the point of the streaming pipeline at multi-host scale.
+        def put(arr, gathered):
             shape = idx.shape + arr.shape[1:]
-            sharding = NamedSharding(self.mesh, P(None, "data"))
+            if gathered is not None:
+                # tfdata: block already gathered; callback just slices.
+                return jax.make_array_from_callback(
+                    shape, sharding, lambda s: gathered[s[0], s[1]])
+            # numpy: each device (and therefore each process) gathers
+            # ONLY the rows of its own 'data' slice — no process ever
+            # materializes the full global batch on the host, which is
+            # the point of the streaming pipeline at multi-host scale.
             return jax.make_array_from_callback(
                 shape, sharding, lambda s: arr[idx[s[0], s[1]]])
 
-        return put(self.train_x), put(self.train_y)
+        return put(self.train_x, x_host), put(self.train_y, y_host)
+
+    def _tf_blocks(self, k: int):
+        """tf.data pipeline yielding gathered (x, y) blocks of k steps,
+        reading index blocks from a private IndexStream clone so the
+        pipeline can prefetch ahead of the training loop."""
+        import tensorflow as tf
+        tf.config.set_visible_devices([], "GPU")   # host-only pipeline
+        lookahead = IndexStream(
+            self.indices.train_n, self.indices.global_batch,
+            self.indices.seed, self.mesh, start_step=self.indices.step)
+
+        def gen():
+            while True:
+                yield lookahead.host_block(k)
+
+        ds = tf.data.Dataset.from_generator(
+            gen, output_signature=tf.TensorSpec(
+                (k, self.indices.global_batch), tf.int32))
+        ds = ds.map(
+            lambda i: (tf.gather(self.train_x, i),
+                       tf.gather(self.train_y, i)),
+            num_parallel_calls=tf.data.AUTOTUNE)
+        return iter(ds.prefetch(2))
+
+    def next_block(self, k: int):
+        if self.source == "numpy":
+            return self._put(self.indices.host_block(k), None, None)
+        if self._tf_iter is None or self._tf_iter[0] != k:
+            # Block size changed (e.g. the final remainder block): rebuild
+            # the pipeline from the current step.
+            self._tf_iter = (k, self._tf_blocks(k))
+        x_t, y_t = next(self._tf_iter[1])
+        # Advance the canonical stream (order authority) in lock-step.
+        idx = self.indices.host_block(k)
+        return self._put(idx, x_t.numpy(), y_t.numpy())

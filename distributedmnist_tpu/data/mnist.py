@@ -91,13 +91,26 @@ def _load_npz(data_dir: str) -> Optional[dict]:
 
 
 def synthetic_mnist(seed: int = 0, train_n: int = TRAIN_N,
-                    test_n: int = TEST_N) -> dict:
+                    test_n: int = TEST_N, noise: float = 0.44,
+                    jitter: int = 3) -> dict:
     """Deterministic, learnable, digit-like 10-class dataset.
 
     Each class is a smooth random template (low-frequency blobs, like pen
     strokes); a sample is its class template under a small random affine-ish
-    jitter (translation) plus pixel noise. Linearly separable enough that an
-    MLP learns it, hard enough that accuracy curves are non-trivial.
+    jitter (translation up to ±`jitter` px) plus Gaussian pixel noise of
+    scale `noise`.
+
+    The default (noise=0.44, jitter=3) is CALIBRATED so the task's
+    difficulty matches real MNIST's headline numbers (BASELINE.md
+    "Synthetic vs real MNIST" section; scripts/calibrate_synthetic.py
+    reproduces the sweep): at 60k/10k scale (6 epochs, Adam+cosine) an
+    MLP 784-128-10 reaches 98.3% test accuracy while LeNet-5 reaches
+    99.1% — mirroring the canonical published MNIST results for the same
+    models (~97.5-98.4% MLP vs ~99.0-99.3% LeNet-5, LeCun et al. 1998 and
+    common reproductions). This makes "wall-clock to 99% on synthetic" an
+    honest stand-in for the real-MNIST target when no real data is
+    mountable (SURVEY.md §7.3): the 99% bar is reachable by the conv
+    model but NOT by the dense-only one, exactly as on MNIST.
     """
     rng = np.random.default_rng(seed)
     # Low-frequency class templates: upsampled 7x7 noise -> 28x28.
@@ -117,16 +130,16 @@ def synthetic_mnist(seed: int = 0, train_n: int = TRAIN_N,
     def make(n, rng):
         y = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
         base = templates[y]                              # (n, 28, 28)
-        # per-sample translation jitter in [-3, 3] px
-        sx = rng.integers(-3, 4, size=n)
-        sy = rng.integers(-3, 4, size=n)
+        # per-sample translation jitter in [-jitter, jitter] px
+        sx = rng.integers(-jitter, jitter + 1, size=n)
+        sy = rng.integers(-jitter, jitter + 1, size=n)
         x = np.empty_like(base)
-        for dx in range(-3, 4):
-            for dy in range(-3, 4):
+        for dx in range(-jitter, jitter + 1):
+            for dy in range(-jitter, jitter + 1):
                 m = (sx == dx) & (sy == dy)
                 if m.any():
                     x[m] = np.roll(np.roll(base[m], dx, axis=1), dy, axis=2)
-        x = x + rng.normal(scale=0.35, size=x.shape)
+        x = x + rng.normal(scale=noise, size=x.shape)
         x = np.clip(x, 0.0, 1.0)
         return (x * 255).astype(np.uint8).reshape(n, *IMG_SHAPE), y
 

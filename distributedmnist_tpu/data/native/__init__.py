@@ -74,10 +74,6 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.idx_read.argtypes = [ctypes.c_char_p,
                                      ctypes.POINTER(ctypes.c_ubyte),
                                      ctypes.c_longlong]
-            lib.epoch_perm.restype = ctypes.c_int
-            lib.epoch_perm.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
-                                       ctypes.c_int32,
-                                       ctypes.POINTER(ctypes.c_int32)]
         except (OSError, AttributeError) as e:
             # Corrupt/incompatible .so (e.g. interrupted build from an old
             # version): disable the native path rather than crash loading.
@@ -112,18 +108,4 @@ def read_idx(path: str) -> Optional[np.ndarray]:
                      out.size)
     if n != out.size:
         raise ValueError(f"native idx_read({path!r}) failed: rc={n}")
-    return out
-
-
-def epoch_perm(seed: int, epoch: int, n: int) -> Optional[np.ndarray]:
-    """Seeded Fisher-Yates permutation of arange(n); None if unavailable.
-    Library utility for host-side pipelines; the trainer's IndexStream uses
-    the canonical numpy permutation for cross-environment reproducibility."""
-    lib = _load()
-    if lib is None:
-        return None
-    out = np.empty(n, dtype=np.int32)
-    lib.epoch_perm(ctypes.c_uint64(seed), ctypes.c_uint64(epoch),
-                   ctypes.c_int32(n),
-                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return out

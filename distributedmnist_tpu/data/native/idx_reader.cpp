@@ -1,20 +1,16 @@
-// Native IDX (MNIST distribution format) reader + epoch permutation
-// generator, exposed to Python via ctypes (distributedmnist_tpu/data/
-// native/__init__.py).
+// Native IDX (MNIST distribution format) reader, exposed to Python via
+// ctypes (distributedmnist_tpu/data/native/__init__.py).
 //
 // Role: the reference's data path is backed by native code (torch's C++
 // DataLoader machinery); this is the framework's native equivalent for the
 // host-side IO it actually has. The hot path on TPU is the on-device index
 // gather (data/loader.py) — host IO happens once at startup, so this
 // component optimizes cold-start: a single mmap-free streamed read with no
-// intermediate Python objects, plus a C implementation of the seeded epoch
-// permutation (SplitMix64 + Fisher-Yates) used when Python-side numpy
-// shuffling would stall a tiny-step hot loop.
+// intermediate Python objects.
 //
 // ABI (stable, C):
 //   idx_probe(path, out_ndim, out_dims[4])         -> 0 ok | <0 errno-ish
 //   idx_read(path, out_buf, buf_len)               -> bytes read | <0 error
-//   epoch_perm(seed, epoch, n, out_idx[n])         -> 0 (int32 permutation)
 
 #include <cstdint>
 #include <cstdio>
@@ -57,14 +53,6 @@ int read_header(FILE *f, Header *h) {
   return 0;
 }
 
-// SplitMix64: tiny, high-quality seeded stream for Fisher-Yates.
-uint64_t splitmix64(uint64_t *s) {
-  uint64_t z = (*s += 0x9E3779B97F4A7C15ull);
-  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
-  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
-  return z ^ (z >> 31);
-}
-
 }  // namespace
 
 extern "C" {
@@ -98,20 +86,6 @@ long long idx_read(const char *path, unsigned char *out, long long cap) {
   fclose(f);
   if (got != h.total) return kErrTrunc;
   return (long long)got;
-}
-
-int epoch_perm(uint64_t seed, uint64_t epoch, int32_t n, int32_t *out) {
-  for (int32_t i = 0; i < n; ++i) out[i] = i;
-  // Mix (seed, epoch) into one stream state; golden-ratio offset keeps
-  // distinct epochs decorrelated even for small seeds.
-  uint64_t s = seed * 0x9E3779B97F4A7C15ull + epoch + 1;
-  for (int32_t i = n - 1; i > 0; --i) {
-    uint64_t j = splitmix64(&s) % uint64_t(i + 1);
-    int32_t t = out[i];
-    out[i] = out[j];
-    out[j] = t;
-  }
-  return 0;
 }
 
 }  // extern "C"

@@ -1,0 +1,45 @@
+"""int32 pixel packing for the device-resident dataset.
+
+The hot-loop batch gather selects rows of the device-resident train set by
+index. On this TPU a row gather is element-count-bound, not byte-bound:
+gathering 196 int32 words per image is ~free while gathering the same 784
+bytes as uint8 costs ~0.11 ms per step at batch 512 (measured round 2,
+scripts/profile_step.py — the uint8 layout tiles poorly). Packing 4 pixels
+per int32 word therefore removes the gather from the step's critical path
+entirely; the unpack (shift/mask, one elementwise op) fuses into the
+normalization and first conv/matmul.
+
+Byte order is little-endian within each word on both sides (numpy view on
+the host, shift/mask in XLA), so packed and unpacked paths produce
+bit-identical pixels — pinned by tests/test_packing.py, which also pins
+trajectory equality of training runs in both formats.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+PIXELS = 28 * 28          # 784 uint8 pixels per image
+WORDS = PIXELS // 4       # 196 int32 words per image
+
+
+def pack_rows(x: np.ndarray) -> np.ndarray:
+    """(N, 28, 28, 1) uint8 -> (N, 196) int32, 4 pixels per word
+    (little-endian byte order within each word)."""
+    if x.dtype != np.uint8:
+        raise ValueError(f"expected uint8 pixels, got {x.dtype}")
+    n = x.shape[0]
+    flat = np.ascontiguousarray(x).reshape(n, PIXELS)
+    return flat.view("<u4").astype(np.int32).reshape(n, WORDS)
+
+
+def unpack_rows(words: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """(..., 196) int32 -> (..., 28, 28, 1) `dtype` in [0, 1] (the /255
+    normalization is fused here so XLA folds unpack+normalize into the
+    consumer). Inverse of pack_rows, bit-exact per pixel."""
+    w = words.astype(jnp.uint32)
+    shifts = jnp.arange(4, dtype=jnp.uint32) * 8
+    b = (w[..., None] >> shifts) & jnp.uint32(0xFF)     # (..., 196, 4)
+    x = b.reshape(*words.shape[:-1], 28, 28, 1).astype(dtype)
+    return x / jnp.asarray(255.0, dtype)

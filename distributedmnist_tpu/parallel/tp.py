@@ -21,10 +21,13 @@ for free.
 
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("distributedmnist_tpu")
 
 MODEL_AXIS = "model"
 
@@ -64,20 +67,42 @@ def state_shardings(state: Any, mesh: Mesh, model_name: str):
     """NamedSharding pytree for a TrainState under the given mesh.
 
     1-D mesh (no 'model' axis): everything replicated — the DP baseline.
-    2-D mesh: the model's rules decide; any leaf whose sharded dim would
-    not divide evenly falls back to replicated.
+    2-D mesh: the model's rules decide. A leaf whose sharded dim doesn't
+    divide the 'model' axis size falls back to replicated WITH a warning;
+    if every matched leaf fell back — or no leaf matched the rules at all
+    (e.g. a layer rename broke the name-based matching) — the run would
+    silently execute as pure DP, so that raises instead.
     """
     if MODEL_AXIS not in mesh.axis_names:
         return jax.tree.map(lambda _: NamedSharding(mesh, P()), state)
     rule = _RULES[model_name]
     mp = mesh.shape[MODEL_AXIS]
+    matched, fell_back = [], []
 
     def leaf(path, x):
         spec = rule(_path_names(path), len(getattr(x, "shape", ())))
+        if spec != P():
+            matched.append(path)
         for dim, axis in enumerate(spec):
             if axis == MODEL_AXIS and x.shape[dim] % mp:
-                spec = P()  # not divisible: replicate rather than fail
+                fell_back.append(path)
+                log.warning(
+                    "TP: %s dim %d (size %d) not divisible by "
+                    "model_parallel=%d; replicating this leaf",
+                    jax.tree_util.keystr(path), dim, x.shape[dim], mp)
+                spec = P()
                 break
         return NamedSharding(mesh, spec)
 
-    return jax.tree_util.tree_map_with_path(leaf, state)
+    out = jax.tree_util.tree_map_with_path(leaf, state)
+    if not matched:
+        raise ValueError(
+            f"model_parallel={mp} requested but no parameter of model "
+            f"{model_name!r} matched the TP placement rules — the run "
+            "would silently execute as pure DP (were layers renamed?)")
+    if len(fell_back) == len(matched):
+        raise ValueError(
+            f"model_parallel={mp} requested but every matched parameter "
+            f"fell back to replicated (no sharded dim divisible by {mp}) "
+            "— the run would silently execute as pure DP")
+    return out

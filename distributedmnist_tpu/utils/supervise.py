@@ -87,8 +87,13 @@ def run_supervised(script: str, argv: list[str],
         teardown_grace = min(30.0, stall_timeout)
         # Hard per-attempt ceiling: a wedged worker that emits periodic
         # chatter (retry warnings, reconnect spam) never goes quiet, so
-        # silence alone cannot bound the attempt.
-        deadline = time.monotonic() + max(20 * stall_timeout, 1800.0)
+        # silence alone cannot bound the attempt. 8x the stall timeout
+        # (floor 40 min) keeps a chattering-but-wedged worker from
+        # burning hours before the kill (the old 20x ratio allowed 100
+        # min) while leaving room for the slowest legitimate attempt —
+        # a multi-batch sweep on the CPU-fallback leg, where one window
+        # takes minutes.
+        deadline = time.monotonic() + max(8 * stall_timeout, 2400.0)
         while proc.poll() is None:
             quiet = time.monotonic() - last[0]
             if accept(out_lines) is not None and quiet > teardown_grace:

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Calibrate synthetic_mnist difficulty against canonical MNIST results.
+
+Canonical published MNIST test accuracies (LeCun et al. 1998 + common
+reproductions): linear ~92%, MLP 784-128(-ish)-10 ~97.5-98.4%, LeNet-5
+~99.0-99.3%. The synthetic task should mirror that profile: MLP plateaus
+BELOW 99%, LeNet-5 exceeds it — so the "wall-clock to 99%" harness on
+synthetic data exercises the same model-capability cliff as real MNIST.
+
+Sweeps (noise, jitter) over candidate values, trains MLP and LeNet on
+each for --epochs, prints a table. Run on CPU:
+
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      PYTHONPATH=/root/repo python scripts/calibrate_synthetic.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--train-n", type=int, default=60_000)
+    p.add_argument("--test-n", type=int, default=10_000)
+    p.add_argument("--grid", default="0.35:3,0.45:4,0.55:4,0.65:4,0.55:5")
+    p.add_argument("--models", default="mlp,lenet")
+    args = p.parse_args()
+
+    from distributedmnist_tpu import trainer
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.data.mnist import synthetic_mnist
+
+    cands = []
+    for item in args.grid.split(","):
+        noise, jitter = item.split(":")
+        cands.append((float(noise), int(jitter)))
+
+    rows = []
+    for noise, jitter in cands:
+        data = synthetic_mnist(seed=0, train_n=args.train_n,
+                               test_n=args.test_n, noise=noise,
+                               jitter=jitter)
+        accs = {}
+        for model in args.models.split(","):
+            cfg = Config(device="cpu", model=model, optimizer="adam",
+                         learning_rate=2e-3, lr_schedule="cosine",
+                         synthetic=True, batch_size=512,
+                         epochs=args.epochs, eval_every=10 ** 9,
+                         log_every=0, target_accuracy=None)
+            out = trainer.fit(cfg, data=data)
+            accs[model] = out["test_accuracy"]
+            print(f"noise={noise} jitter={jitter} {model}: "
+                  f"{out['test_accuracy']:.4f}", file=sys.stderr,
+                  flush=True)
+        rows.append((noise, jitter, accs))
+
+    print(f"{'noise':>6} {'jitter':>6} " + " ".join(
+        f"{m:>8}" for m in args.models.split(",")))
+    for noise, jitter, accs in rows:
+        print(f"{noise:>6} {jitter:>6} " + " ".join(
+            f"{accs[m]:>8.4f}" for m in args.models.split(",")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Slice-by-slice profile of the fused train step on the default backend.
+
+Isolates each component of the hot-loop step (scan floor, batch gather
+variants, forward, backward, optimizer variants, gather/compute
+double-buffering) as its OWN scanned+jitted program and times each with
+the honest fetch barrier (StepTimer.barrier — block_until_ready lies on
+this host's relay backend). Prints one JSON line per variant plus a
+summary table on stderr.
+
+Usage: timeout 900 python scripts/profile_step.py [--batch 512] [--k 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def mark(msg):
+    print(f"profile: {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=512)
+    p.add_argument("--k", type=int, default=256, help="scan length")
+    p.add_argument("--blocks", type=int, default=4)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--only", default=None,
+                   help="comma-separated variant names to run")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributedmnist_tpu import models, optim
+    from distributedmnist_tpu.data import load_mnist
+    from distributedmnist_tpu.data.loader import DeviceDataset, IndexStream
+    from distributedmnist_tpu.parallel import make_mesh, replicated
+    from distributedmnist_tpu.trainer import (
+        init_state, make_train_step, _forward_loss, _make_one_step)
+    from distributedmnist_tpu.utils import StepTimer, enable_compilation_cache
+
+    enable_compilation_cache()
+    devs = jax.devices()
+    mark(f"backend up: {len(devs)}x {devs[0].platform}")
+    mesh = make_mesh(devs)
+    B, K = args.batch, args.k
+
+    data = load_mnist(None, synthetic=True, seed=0)
+    ds = DeviceDataset(data, mesh)
+    model = models.build("lenet", platform=devs[0].platform)
+    tx = optax.adam(1e-3)
+    tx_flat = optax.flatten(optax.adam(1e-3))
+    loss_fn = _forward_loss(model, jnp.float32)
+
+    # int32-packed pixels — the PRODUCTION pack/unpack (data/packing.py),
+    # so these timings describe the shipped layout, not a local variant.
+    from distributedmnist_tpu.data.packing import pack_rows, unpack_rows
+    train_xp = jax.device_put(pack_rows(data["train_x"]), replicated(mesh))
+    unpack = unpack_rows
+
+    def loss_packed(params, words, y):
+        logits = model.apply({"params": params}, unpack(words))
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    idx_host = np.random.default_rng(0).integers(
+        0, ds.train_n, size=(K, B)).astype(np.int32)
+    idx = jax.device_put(idx_host, replicated(mesh))
+
+    one_step = _make_one_step(loss_fn, tx)
+    one_step_flat = _make_one_step(loss_fn, tx_flat)
+
+    def fresh(tx_):
+        return lambda: jax.device_put(
+            init_state(jax.random.PRNGKey(0), model, tx_,
+                       jnp.zeros((1, 28, 28, 1))), replicated(mesh))
+    mk_state, mk_state_flat = fresh(tx), fresh(tx_flat)
+    zero = lambda: jnp.zeros(())
+
+    def scanned(body):
+        def f(carry, idx):
+            return jax.lax.scan(body, carry, idx)
+        return jax.jit(f, donate_argnums=0)
+
+    # --- variants ----------------------------------------------------
+    variants = {}
+
+    def v_empty(carry, ix):
+        return carry + ix[0].astype(jnp.float32), ix[0]
+    variants["empty"] = (scanned(v_empty), zero)
+
+    def v_gather_u8(carry, ix):
+        x = jnp.take(ds.train_x, ix, axis=0)
+        return carry + x.astype(jnp.float32).sum(), None
+    variants["gather_u8"] = (scanned(v_gather_u8), zero)
+
+    def v_gather_packed(carry, ix):
+        w = jnp.take(train_xp, ix, axis=0)
+        return carry + unpack(w).sum(), None
+    variants["gather_packed"] = (scanned(v_gather_packed), zero)
+
+    def v_fwd(state_c, ix):
+        x = jnp.take(ds.train_x, ix, axis=0)
+        y = jnp.take(ds.train_y, ix, axis=0)
+        loss = loss_fn(state_c.params, x, y)
+        return state_c, loss
+    variants["fwd"] = (scanned(v_fwd), mk_state)
+
+    const_x = jnp.take(ds.train_x, idx[0], axis=0)
+    const_y = jnp.take(ds.train_y, idx[0], axis=0)
+
+    def v_fwd_nogather(state_c, ix):
+        # XOR with a scanned scalar defeats loop-invariant hoisting of the
+        # whole forward while adding only one cheap elementwise op.
+        x = const_x ^ (ix[0] & 1).astype(jnp.uint8)
+        loss = loss_fn(state_c.params, x, const_y)
+        return state_c, loss
+    variants["fwd_nogather"] = (scanned(v_fwd_nogather), mk_state)
+
+    def v_fwdbwd(state_c, ix):
+        x = jnp.take(ds.train_x, ix, axis=0)
+        y = jnp.take(ds.train_y, ix, axis=0)
+        loss, grads = jax.value_and_grad(loss_fn)(state_c.params, x, y)
+        leaf = jax.tree.leaves(grads)[0]
+        return state_c, loss + leaf.sum().astype(jnp.float32)
+    variants["fwdbwd"] = (scanned(v_fwdbwd), mk_state)
+
+    def v_full(state_c, ix):
+        x = jnp.take(ds.train_x, ix, axis=0)
+        y = jnp.take(ds.train_y, ix, axis=0)
+        return one_step(state_c, x, y)
+    variants["full_adam"] = (scanned(v_full), mk_state)
+
+    def v_full_flat(state_c, ix):
+        x = jnp.take(ds.train_x, ix, axis=0)
+        y = jnp.take(ds.train_y, ix, axis=0)
+        return one_step_flat(state_c, x, y)
+    variants["full_adam_flat"] = (scanned(v_full_flat), mk_state_flat)
+
+    one_step_flat_packed = _make_one_step(
+        lambda p, w, y: loss_packed(p, w, y), tx_flat)
+
+    def v_full_flat_packed(state_c, ix):
+        w = jnp.take(train_xp, ix, axis=0)
+        y = jnp.take(ds.train_y, ix, axis=0)
+        return one_step_flat_packed(state_c, w, y)
+    variants["full_flat_packed"] = (scanned(v_full_flat_packed), mk_state_flat)
+
+    # double-buffered: body consumes the carried batch, gathers the next
+    def v_dbuf_body(carry, ix):
+        state_c, xb, yb = carry
+        new_state, loss = one_step_flat(state_c, xb, yb)
+        xn = jnp.take(ds.train_x, ix, axis=0)
+        yn = jnp.take(ds.train_y, ix, axis=0)
+        return (new_state, xn, yn), loss
+
+    def dbuf_fn(carry, idx):
+        state_c = carry
+        x0 = jnp.take(ds.train_x, idx[0], axis=0)
+        y0 = jnp.take(ds.train_y, idx[0], axis=0)
+        (state_c, _, _), losses = jax.lax.scan(
+            v_dbuf_body, (state_c, x0, y0), jnp.roll(idx, -1, axis=0))
+        return state_c, losses
+    variants["full_flat_dbuf"] = (jax.jit(dbuf_fn, donate_argnums=0), mk_state_flat)
+
+    def sync_of(carry, out):
+        # ALWAYS fetch something that depends on every iteration's work:
+        # the stacked per-step outputs when present, else the carry.
+        return out if out is not None else carry
+
+    only = set(args.only.split(",")) if args.only else None
+    results = {}
+    for name, (fn, mk_carry) in variants.items():
+        if only and name not in only:
+            continue
+        mark(f"{name}: compiling")
+        carry = mk_carry()
+        carry, out = fn(carry, idx)            # compile + warmup
+        StepTimer.barrier(sync_of(carry, out))
+        times = []
+        for r in range(args.repeats):
+            t0 = time.perf_counter()
+            for _ in range(args.blocks):
+                carry, out = fn(carry, idx)
+            StepTimer.barrier(sync_of(carry, out))
+            times.append((time.perf_counter() - t0)
+                         / (args.blocks * K) * 1e3)
+        ms = sorted(times)[len(times) // 2]
+        results[name] = ms
+        mark(f"{name}: {ms:.4f} ms/iter  (all: "
+             + ", ".join(f"{t:.4f}" for t in times) + ")")
+
+    floor = results.get("empty", 0.0)
+    print(json.dumps({"batch": B, "k": K, "floor_ms": floor,
+                      "ms_per_iter": results}))
+    for name, ms in results.items():
+        net = ms - floor
+        print(f"{name:22s} {ms:8.4f} ms  (net {net:8.4f})  "
+              f"{B / ms * 1000:10.0f} img/s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,5 @@
-"""Native C++ IDX reader / permutation tests: builds via g++ (skipped when
-no toolchain), asserts byte-identical parity with the Python parser and
-permutation validity/determinism."""
+"""Native C++ IDX reader tests: builds via g++ (skipped when no
+toolchain), asserts byte-identical parity with the Python parser."""
 
 import gzip
 import struct
@@ -67,16 +66,6 @@ def test_gzip_still_uses_python_path(tmp_path):
         fout.write(fin.read())
     from distributedmnist_tpu.data.mnist import _read_idx
     np.testing.assert_array_equal(_read_idx(gz), arr)
-
-
-@requires_native
-def test_native_epoch_perm_is_permutation():
-    p0 = native.epoch_perm(seed=7, epoch=0, n=1000)
-    assert sorted(p0.tolist()) == list(range(1000))
-    # deterministic per (seed, epoch), distinct across epochs/seeds
-    np.testing.assert_array_equal(p0, native.epoch_perm(7, 0, 1000))
-    assert not np.array_equal(p0, native.epoch_perm(7, 1, 1000))
-    assert not np.array_equal(p0, native.epoch_perm(8, 0, 1000))
 
 
 def test_available_never_compiles(tmp_path, monkeypatch):

@@ -112,8 +112,11 @@ def test_train_supervised_forwards_summary():
 def test_bench_time_to_accuracy_contract():
     rec = _run_bench(["--mode", "time-to-accuracy", "--model", "mlp",
                       "--target-accuracy", "0.5", "--global-batch", "256",
-                      "--max-epochs", "2"])
+                      "--max-epochs", "2", "--trials", "2"])
     assert rec["metric"] == "wall_clock_to_target_accuracy"
     assert rec["unit"] == "seconds"
     assert rec["detail"]["reached_target"] is True
     assert rec["detail"]["final_accuracy"] >= 0.5
+    d = rec["detail"]
+    assert d["trials"] == 2 and len(d["trials_s"]) == 2
+    assert d["min_s"] <= rec["value"] <= d["max_s"]

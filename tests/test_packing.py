@@ -1,0 +1,115 @@
+"""Packed-pixel layout + flat-optimizer equivalence tests.
+
+The two round-2 step optimizations (config.py pixel_format="packed",
+flat_optimizer=True) are pure re-layouts: packed rows decode to
+bit-identical pixels (data/packing.py) and the flat optimizer applies the
+same elementwise update to a concatenation of the leaves. Both must leave
+training trajectories unchanged — pinned here against the u8/per-leaf
+forms on the 8-virtual-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedmnist_tpu import optim, trainer
+from distributedmnist_tpu.config import Config
+from distributedmnist_tpu.data.packing import pack_rows, unpack_rows
+
+
+BASE = Config(device="cpu", synthetic=True, log_every=0,
+              target_accuracy=None, batch_size=256, num_devices=8,
+              steps=12, eval_every=12)
+
+
+def test_pack_unpack_roundtrip_bit_exact(rng):
+    x = rng.integers(0, 256, (37, 28, 28, 1)).astype(np.uint8)
+    words = pack_rows(x)
+    assert words.shape == (37, 196) and words.dtype == np.int32
+    back = np.asarray(unpack_rows(jnp.asarray(words)))
+    np.testing.assert_array_equal(back, x.astype(np.float32) / 255.0)
+
+
+def test_pack_rejects_non_uint8():
+    with pytest.raises(ValueError, match="uint8"):
+        pack_rows(np.zeros((2, 28, 28, 1), np.float32))
+
+
+def test_unpack_batched_axes(rng):
+    # (K, B, 196) blocks — the scanned superstep's shape — unpack too.
+    x = rng.integers(0, 256, (6, 28, 28, 1)).astype(np.uint8)
+    words = jnp.asarray(pack_rows(x)).reshape(2, 3, 196)
+    out = unpack_rows(words)
+    assert out.shape == (2, 3, 28, 28, 1)
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(6, 28, 28, 1),
+        x.astype(np.float32) / 255.0)
+
+
+@pytest.mark.parametrize("model", ["mlp", "lenet"])
+def test_packed_matches_u8_trajectory(tiny_data, model):
+    kw = dict(model=model, optimizer="adam", learning_rate=1e-3,
+              flat_optimizer=False)
+    a = trainer.fit(BASE.replace(pixel_format="u8", **kw), data=tiny_data)
+    b = trainer.fit(BASE.replace(pixel_format="packed", **kw),
+                    data=tiny_data)
+    assert a["pixel_format"] == "u8" and b["pixel_format"] == "packed"
+    np.testing.assert_allclose(a["final_loss"], b["final_loss"],
+                               rtol=0, atol=1e-6)
+    assert a["test_accuracy"] == b["test_accuracy"]
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_flat_matches_per_leaf_trajectory(tiny_data, opt):
+    kw = dict(model="lenet", optimizer=opt, learning_rate=1e-3,
+              pixel_format="u8")
+    a = trainer.fit(BASE.replace(flat_optimizer=False, **kw),
+                    data=tiny_data)
+    b = trainer.fit(BASE.replace(flat_optimizer=True, **kw),
+                    data=tiny_data)
+    np.testing.assert_allclose(a["final_loss"], b["final_loss"],
+                               rtol=0, atol=1e-6)
+    assert a["test_accuracy"] == b["test_accuracy"]
+
+
+def test_production_defaults_packed_flat(tiny_data):
+    # The defaults themselves (packed + flat + explicit-mode off) train.
+    out = trainer.fit(BASE.replace(model="lenet", optimizer="adam",
+                                   learning_rate=1e-3, steps=30,
+                                   eval_every=30),
+                      data=tiny_data)
+    assert out["pixel_format"] == "packed"
+    assert np.isfinite(out["final_loss"])
+
+
+def test_packed_explicit_mode(tiny_data):
+    # shard_map + local gather of packed words + pmean: the explicit SPMD
+    # mode composes with the packed layout too.
+    kw = dict(model="mlp", optimizer="sgd", learning_rate=0.02,
+              pixel_format="packed")
+    a = trainer.fit(BASE.replace(spmd_mode="auto", **kw), data=tiny_data)
+    b = trainer.fit(BASE.replace(spmd_mode="explicit", **kw),
+                    data=tiny_data)
+    np.testing.assert_allclose(a["test_accuracy"], b["test_accuracy"],
+                               atol=1e-6)
+
+
+def test_grad_accum_packed(tiny_data):
+    # microbatch re-gathers slice the packed dataset identically
+    kw = dict(model="mlp", optimizer="sgd", learning_rate=0.02,
+              pixel_format="packed")
+    a = trainer.fit(BASE.replace(grad_accum=1, **kw), data=tiny_data)
+    b = trainer.fit(BASE.replace(grad_accum=4, **kw), data=tiny_data)
+    np.testing.assert_allclose(a["final_loss"], b["final_loss"],
+                               rtol=0, atol=1e-5)
+
+
+def test_unknown_pixel_format_rejected(tiny_data):
+    from distributedmnist_tpu.data.loader import DeviceDataset
+    from distributedmnist_tpu.parallel import make_mesh
+    with pytest.raises(ValueError, match="pixel format"):
+        DeviceDataset(tiny_data, make_mesh(jax.devices()[:1]),
+                      pixel_format="float64")
+    with pytest.raises(ValueError, match="pixel format"):
+        trainer._decoder("float64", jnp.float32)

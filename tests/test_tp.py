@@ -90,6 +90,56 @@ def test_tp_matches_dp(tiny_data, model_name):
                                atol=2e-3)
 
 
+def test_tp_live_array_placement(eight_devices):
+    """The intended specs must land on the LIVE arrays after device_put —
+    numerics tests alone can't catch a silent fall-back to pure DP."""
+    mesh = make_mesh(eight_devices, model_parallel=2)
+    for name in ("mlp", "lenet"):
+        model = models.build(name, fused="xla")
+        tx = optim.build("adam", 1e-3)
+        state = trainer.init_state(jax.random.PRNGKey(0), model, tx,
+                                   jnp.zeros((1, 28, 28, 1)))
+        state = jax.device_put(state, tp.state_shardings(state, mesh, name))
+        p = state.params
+        if name == "mlp":
+            assert p["hidden"]["kernel"].sharding.spec == P(None, "model")
+            assert p["logits"]["kernel"].sharding.spec == P("model", None)
+            mu = state.opt_state[0].mu
+            assert mu["hidden"]["kernel"].sharding.spec == P(None, "model")
+        else:
+            assert p["fc1"]["kernel"].sharding.spec == P(None, "model")
+            assert p["fc2"]["kernel"].sharding.spec == P("model", None)
+            assert p["conv1"]["kernel"].sharding.spec == P()
+
+
+def test_tp_all_fallback_raises(eight_devices):
+    # Every matched leaf indivisible -> the run would silently be pure DP;
+    # that must raise, not warn.
+    mesh = make_mesh(eight_devices, model_parallel=2)
+    fake = {"hidden": {"kernel": np.zeros((7, 9))}}
+    with pytest.raises(ValueError, match="fell back to replicated"):
+        tp.state_shardings(fake, mesh, "mlp")
+
+
+def test_tp_no_match_raises(eight_devices):
+    # A layer rename that defeats the name-based rules must raise.
+    mesh = make_mesh(eight_devices, model_parallel=2)
+    fake = {"encoder": {"kernel": np.zeros((8, 8))}}
+    with pytest.raises(ValueError, match="no parameter"):
+        tp.state_shardings(fake, mesh, "mlp")
+
+
+def test_tp_partial_fallback_warns(eight_devices, caplog):
+    mesh = make_mesh(eight_devices, model_parallel=2)
+    fake = {"hidden": {"kernel": np.zeros((4, 6)), "bias": np.zeros(7)}}
+    import logging
+    with caplog.at_level(logging.WARNING, logger="distributedmnist_tpu"):
+        sh = tp.state_shardings(fake, mesh, "mlp")
+    assert sh["hidden"]["kernel"].spec == P(None, "model")
+    assert sh["hidden"]["bias"].spec == P()
+    assert any("replicating this leaf" in r.message for r in caplog.records)
+
+
 def test_tp_explicit_mode_rejected(tiny_data):
     with pytest.raises(ValueError, match="spmd_mode=auto"):
         trainer.fit(BASE.replace(spmd_mode="explicit", model_parallel=2),
