@@ -43,7 +43,14 @@ class Checkpointer:
 
     def maybe_restore(self, state: Any) -> Tuple[Any, bool]:
         """Restore the latest checkpoint into `state`'s structure (shapes,
-        dtypes AND shardings preserved), or return `state` unchanged."""
+        dtypes AND shardings preserved), or return `state` unchanged.
+
+        A checkpoint written with the OTHER optimizer-state layout (flat
+        single-vector vs per-leaf — config.flat_optimizer) is converted
+        automatically: the moment vectors are raveled/unraveled between
+        layouts (optax.flatten concatenates leaves in jax.tree.flatten
+        order, so the conversion is exact), and training resumes
+        bit-identically without an operator flag."""
         step = self.mgr.latest_step()
         if step is None:
             return state, False
@@ -59,16 +66,103 @@ class Checkpointer:
             # half-written directory, permissions — raise OSError and
             # pass through untouched). The most common cause: the
             # checkpoint was written with the other optimizer-state
-            # layout (flat single-vector vs per-leaf —
-            # config.flat_optimizer changed its default in round 2).
-            # Surface the knob instead of an opaque pytree error.
-            raise ValueError(
-                f"checkpoint at step {step} in {self.directory!r} does "
-                "not match this run's training-state structure. If it "
-                "was written by a run with the other optimizer layout, "
-                "retry with --no-flat-optimizer (or its inverse); "
-                f"original error: {e}") from e
+            # layout; try the exact flat<->per-leaf conversion before
+            # giving up, and surface the knob instead of an opaque
+            # pytree error if that fails too.
+            restored = self._restore_other_layout(step, abstract)
+            if restored is None:
+                raise ValueError(
+                    f"checkpoint at step {step} in {self.directory!r} "
+                    "does not match this run's training-state structure "
+                    "(and is not a flat<->per-leaf optimizer-layout "
+                    f"variant of it); original error: {e}") from e
+            log.info("restored checkpoint at step %d via flat<->per-leaf "
+                     "optimizer-layout conversion", step)
         return restored, True
+
+    def _restore_other_layout(self, step: int, abstract: Any):
+        """Restore a checkpoint whose optimizer state was written in the
+        other layout (optax.flatten's single vector per moment vs one
+        array per param leaf) and convert it into `abstract`'s layout.
+        Returns None if the checkpoint is not the other layout either."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        params_abs = abstract.params
+        params_def = jax.tree.structure(params_abs)
+        p_leaves = jax.tree.leaves(params_abs)
+        flat_size = sum(p.size for p in p_leaves)
+
+        def momentlike(x) -> bool:
+            # a subtree shaped exactly like params (per-leaf moments)
+            return (not isinstance(x, jax.ShapeDtypeStruct)
+                    and not isinstance(x, jax.Array)
+                    and jax.tree.structure(x) == params_def)
+
+        def flatlike(x) -> bool:
+            # a single raveled moment vector (optax.flatten's state)
+            return getattr(x, "ndim", None) == 1 and x.size == flat_size
+
+        target_flat = any(flatlike(l)
+                          for l in jax.tree.leaves(abstract.opt_state))
+        if target_flat:
+            # source layout: per-leaf — expand each flat vector into a
+            # params-shaped subtree (placed like the params themselves)
+            def source_leaf(leaf):
+                if flatlike(leaf):
+                    return jax.tree.map(
+                        lambda p: jax.ShapeDtypeStruct(
+                            p.shape, leaf.dtype, sharding=p.sharding),
+                        params_abs)
+                return leaf
+            src_opt = jax.tree.map(source_leaf, abstract.opt_state)
+        else:
+            # source layout: flat — collapse each params-shaped moment
+            # subtree into one (flat_size,) vector, replicated like the
+            # (scalar, replicated) step counter
+            rep = abstract.step.sharding
+
+            def source_sub(x):
+                if momentlike(x):
+                    return jax.ShapeDtypeStruct(
+                        (flat_size,), p_leaves[0].dtype, sharding=rep)
+                return x
+            src_opt = jax.tree.map(source_sub, abstract.opt_state,
+                                   is_leaf=momentlike)
+
+        src_abstract = abstract.replace(opt_state=src_opt)
+        try:
+            src = self.mgr.restore(
+                step, args=ocp.args.StandardRestore(src_abstract))
+        except (ValueError, TypeError, KeyError):
+            return None
+
+        if target_flat:
+            def to_target(x):
+                if momentlike(x):
+                    return jnp.concatenate(
+                        [jnp.reshape(v, (-1,))
+                         for v in jax.tree.leaves(x)])
+                return x
+            tgt_opt = jax.tree.map(to_target, src.opt_state,
+                                   is_leaf=momentlike)
+        else:
+            offsets = np.cumsum([p.size for p in p_leaves])[:-1]
+
+            def to_target(x):
+                if flatlike(x):
+                    parts = jnp.split(x, offsets)
+                    return jax.tree.unflatten(
+                        params_def,
+                        [jnp.reshape(v, p.shape)
+                         for v, p in zip(parts, p_leaves)])
+                return x
+            tgt_opt = jax.tree.map(to_target, src.opt_state)
+        # final placement: every converted leaf takes the target sharding
+        tgt_opt = jax.tree.map(
+            lambda v, a: jax.device_put(v, a.sharding),
+            tgt_opt, abstract.opt_state)
+        return src.replace(opt_state=tgt_opt)
 
     def wait(self) -> None:
         self.mgr.wait_until_finished()

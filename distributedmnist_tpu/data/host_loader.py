@@ -109,8 +109,13 @@ class HostStream:
         if self.source == "numpy":
             return self._put(self.indices.host_block(k), None, None)
         if self._tf_iter is None or self._tf_iter[0] != k:
-            # Block size changed (e.g. the final remainder block): rebuild
-            # the pipeline from the current step.
+            # Block size changed (e.g. the final remainder block): dispose
+            # the old pipeline BEFORE building its replacement — dropping
+            # the only reference reclaims its background threads and
+            # prefetched blocks now, not whenever GC next runs with two
+            # live pipelines. Order parity is unaffected: the canonical
+            # IndexStream below is the sole batch-order authority.
+            self._tf_iter = None
             self._tf_iter = (k, self._tf_blocks(k))
         x_t, y_t = next(self._tf_iter[1])
         # Advance the canonical stream (order authority) in lock-step.

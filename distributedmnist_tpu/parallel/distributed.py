@@ -31,16 +31,18 @@ def maybe_initialize(coordinator_address: Optional[str],
                      process_id: Optional[int]) -> bool:
     """Rendezvous with the other hosts iff multi-host flags are present.
 
-    Returns True when running multi-host. Idempotent-safe for tests: raises
-    cleanly if jax.distributed was already initialized.
+    Returns True when running multi-host. Idempotent: a second fit() in an
+    already-initialized process (e.g. back-to-back workloads in one
+    worker) keeps the existing rendezvous instead of raising.
     """
     if coordinator_address is None:
         return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    if not jax.distributed.is_initialized():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
     return True
 
 

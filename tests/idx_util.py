@@ -1,0 +1,24 @@
+"""Shared test helper: write a synthetic dataset as REAL-format raw IDX
+fixture files (the exact on-disk layout of the MNIST distribution), so any
+test can exercise the full --data-dir loading path — Python parser or the
+native C++ reader — without network access."""
+
+import os
+import struct
+
+import numpy as np
+
+
+def write_idx_fixtures(dirpath, src: dict) -> None:
+    """Write src (a synthetic_mnist()-shaped dict) into dirpath as the four
+    canonical MNIST IDX files."""
+    names = {"train-images-idx3-ubyte": src["train_x"][..., 0],
+             "train-labels-idx1-ubyte": src["train_y"],
+             "t10k-images-idx3-ubyte": src["test_x"][..., 0],
+             "t10k-labels-idx1-ubyte": src["test_y"]}
+    for name, arr in names.items():
+        dims = arr.shape
+        with open(os.path.join(dirpath, name), "wb") as f:
+            f.write(struct.pack(f">I{len(dims)}I",
+                                0x0800 | len(dims), *dims))
+            f.write(np.ascontiguousarray(arr, dtype=np.uint8).tobytes())

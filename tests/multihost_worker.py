@@ -2,17 +2,76 @@
 multi-host simulated by multiple processes with jax.distributed.initialize
 on localhost ports). Each worker owns 4 virtual CPU devices; N workers form
 one 4N-device global mesh and run the REAL multi-host code path:
-DCN-style rendezvous, per-process batch assembly, global collectives."""
+DCN-style rendezvous, per-process batch assembly, global collectives.
 
+--data-pipeline stream additionally runs the streaming host pipeline
+(data/host_loader.HostStream) under process_count > 1 — the one property
+that justifies its existence: each process host-gathers ONLY the rows of
+its own addressable 'data' shards, never the full global batch. The worker
+instruments the numpy gather to prove it, and runs the device-resident
+pipeline on the same seed so the test can assert trajectory equivalence.
+"""
+
+import argparse
 import json
 import os
 import sys
 
+import numpy as np
+
+
+_TRACKED_ROWS: set = set()
+
+
+class _TrackingArray(np.ndarray):
+    """numpy view that records every row index touched by fancy
+    integer-array indexing — the gather HostStream's per-device placement
+    callback performs."""
+
+    def __getitem__(self, item):
+        if isinstance(item, np.ndarray) and item.dtype.kind in "iu":
+            _TRACKED_ROWS.update(np.asarray(item).ravel().tolist())
+        return np.asarray(super().__getitem__(item))
+
+
+def _expected_stream_rows(cfg, data, steps: int) -> set:
+    """Rows this process's addressable devices own, replayed from the
+    canonical IndexStream: the 'data' axis position of each addressable
+    device maps to a column range of every global batch."""
+    import jax
+
+    from distributedmnist_tpu.data.loader import IndexStream
+    from distributedmnist_tpu.parallel import get_devices, make_mesh
+
+    mesh = make_mesh(get_devices(cfg.device, cfg.num_devices))
+    mesh_devs = list(mesh.devices.flat)
+    shard = cfg.batch_size // len(mesh_devs)
+    cols = np.concatenate([
+        np.arange(i * shard, (i + 1) * shard)
+        for i, d in enumerate(mesh_devs)
+        if d.process_index == jax.process_index()])
+    ref = IndexStream(data["train_x"].shape[0], cfg.batch_size,
+                      cfg.seed, mesh)
+    expected: set = set()
+    full: set = set()
+    for s in range(steps):
+        idx = ref.indices_for_step(s)
+        expected.update(idx[cols].tolist())
+        full.update(idx.tolist())
+    return expected, full
+
 
 def main() -> int:
-    process_id = int(sys.argv[1])
-    num_processes = int(sys.argv[2])
-    port = sys.argv[3]
+    p = argparse.ArgumentParser()
+    p.add_argument("process_id", type=int)
+    p.add_argument("num_processes", type=int)
+    p.add_argument("port")
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--fail-at", type=int, default=None)
+    p.add_argument("--data-pipeline", choices=["device", "stream"],
+                   default="device")
+    args = p.parse_args()
+
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
@@ -23,31 +82,55 @@ def main() -> int:
     from distributedmnist_tpu.config import Config
     from distributedmnist_tpu.data import synthetic_mnist
 
-    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
-    fail_at = int(sys.argv[5]) if len(sys.argv) > 5 else None
-
     data = synthetic_mnist(seed=1, train_n=1024, test_n=256)
     cfg = Config(model="mlp", optimizer="sgd", learning_rate=0.02,
                  batch_size=64, steps=6, eval_every=6, device="cpu",
                  synthetic=True, log_every=0, target_accuracy=None,
-                 coordinator_address=f"localhost:{port}",
-                 num_processes=num_processes, process_id=process_id,
-                 checkpoint_dir=ckpt_dir, checkpoint_every=3,
-                 fail_at_step=fail_at)
+                 coordinator_address=f"localhost:{args.port}",
+                 num_processes=args.num_processes,
+                 process_id=args.process_id,
+                 checkpoint_dir=args.ckpt_dir, checkpoint_every=3,
+                 fail_at_step=args.fail_at)
     try:
         out = trainer.fit(cfg, data=data)
     except trainer.SimulatedFailure:
         print("MHFAILED injected", flush=True)
         return 0
-    print("MHRESULT " + json.dumps({
-        "process_id": process_id,
+    result = {
+        "process_id": args.process_id,
         "steps": out["steps"],
         "accuracy": out["test_accuracy"],
         "n_chips": out["n_chips"],
         "n_processes": out["n_processes"],
         "multihost": out["multihost"],
         "restored": out["restored"],
-    }), flush=True)
+    }
+
+    if args.data_pipeline == "stream":
+        # Same seed, same data, streaming pipeline — with the host
+        # gather instrumented. The rendezvous from the first fit is
+        # reused (maybe_initialize is idempotent).
+        tracked = dict(
+            data,
+            train_x=data["train_x"].view(_TrackingArray),
+            train_y=data["train_y"].view(_TrackingArray))
+        s_out = trainer.fit(cfg.replace(data_pipeline="stream",
+                                        checkpoint_dir=None),
+                            data=tracked)
+        expected, full = _expected_stream_rows(cfg, data, s_out["steps"])
+        result.update({
+            "stream_accuracy": s_out["test_accuracy"],
+            "stream_steps": s_out["steps"],
+            "stream_rows_touched": len(_TRACKED_ROWS),
+            "stream_rows_expected": len(expected),
+            # the defining multi-host property: ONLY addressable-shard
+            # rows were ever host-gathered by this process — a strict
+            # subset of what the global batches contained
+            "stream_rows_ok": _TRACKED_ROWS == expected,
+            "stream_full_batch_avoided": len(expected) < len(full),
+        })
+
+    print("MHRESULT " + json.dumps(result), flush=True)
     return 0
 
 

@@ -5,6 +5,7 @@ no-checkpoint no-op."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributedmnist_tpu import models, optim
 from distributedmnist_tpu.checkpoint import Checkpointer
@@ -12,10 +13,10 @@ from distributedmnist_tpu.parallel import make_mesh, replicated
 from distributedmnist_tpu.trainer import TrainState, init_state
 
 
-def _state(eight_devices, step=0):
+def _state(eight_devices, step=0, flat=False, optimizer="adam"):
     mesh = make_mesh(eight_devices)
     model = models.build("mlp", fused="xla")
-    tx = optim.build("adam", 1e-3)
+    tx = optim.build(optimizer, 1e-3, flat=flat)
     state = init_state(jax.random.PRNGKey(7), model, tx,
                        jnp.zeros((1, 28, 28, 1)))
     state = state.replace(step=jnp.asarray(step, jnp.int32))
@@ -73,6 +74,103 @@ def test_max_to_keep_garbage_collects(tmp_path, eight_devices):
     steps = sorted(ckpt.mgr.all_steps())
     ckpt.close()
     assert steps == [3, 4]
+
+
+def _optimizer_vectors(state):
+    """All float moment data in the optimizer state as one flat vector,
+    layout-independent (optax.flatten concatenates in jax.tree.flatten
+    order, so both layouts ravel to identical bytes)."""
+    moments = [np.asarray(l).ravel()
+               for l in jax.tree.leaves(state.opt_state)
+               if np.asarray(l).dtype == np.float32]
+    return np.concatenate(moments) if moments else np.zeros(0)
+
+
+@pytest.mark.parametrize("optimizer", ["adam", "sgd"])
+@pytest.mark.parametrize("saved_flat", [True, False])
+def test_cross_layout_restore(tmp_path, eight_devices, saved_flat,
+                              optimizer):
+    """A checkpoint written with one optimizer-state layout (flat vector
+    vs per-leaf) restores into a run using the OTHER layout, exactly —
+    no --no-flat-optimizer operator step (round-2 verdict, item #9)."""
+    saved = _state(eight_devices, step=9, flat=saved_flat,
+                   optimizer=optimizer)
+    # make moments non-trivial so the conversion is actually checked
+    saved = saved.replace(opt_state=jax.tree.map(
+        lambda l: (l + jnp.arange(l.size, dtype=l.dtype).reshape(l.shape)
+                   if l.dtype == jnp.float32 else l),
+        saved.opt_state))
+    d = str(tmp_path / "x")
+    ckpt = Checkpointer(d)
+    ckpt.save(9, saved)
+    ckpt.wait()
+    ckpt.close()
+
+    target = _state(eight_devices, step=0, flat=not saved_flat,
+                    optimizer=optimizer)
+    ckpt2 = Checkpointer(d)
+    restored, ok = ckpt2.maybe_restore(target)
+    ckpt2.close()
+    assert ok and int(restored.step) == 9
+    _assert_tree_equal(restored.params, saved.params)
+    # target structure, saved values
+    assert (jax.tree.structure(restored.opt_state)
+            == jax.tree.structure(target.opt_state))
+    np.testing.assert_array_equal(_optimizer_vectors(restored),
+                                  _optimizer_vectors(saved))
+    # placement: converted leaves are replicated over the mesh like the
+    # target's
+    leaf = [l for l in jax.tree.leaves(restored.opt_state)
+            if hasattr(l, "sharding")][0]
+    assert len(leaf.sharding.device_set) == 8
+
+
+def test_cross_layout_resume_trajectory(tmp_path, tiny_data):
+    """fit() with the converted optimizer state continues EXACTLY the
+    trajectory of a run that never switched layouts."""
+    from distributedmnist_tpu import trainer
+    from distributedmnist_tpu.config import Config
+
+    base = Config(device="cpu", num_devices=8, synthetic=True,
+                  model="mlp", optimizer="adam", learning_rate=1e-3,
+                  fused_kernels="xla", batch_size=256, log_every=0,
+                  target_accuracy=None, eval_every=1000,
+                  checkpoint_every=8)
+    # uninterrupted 16-step run in the per-leaf layout = the oracle
+    oracle = trainer.fit(base.replace(
+        steps=16, flat_optimizer=False,
+        checkpoint_dir=str(tmp_path / "a")), data=tiny_data)
+    # 8 steps per-leaf -> resume in the FLAT layout for the final 8
+    ck = str(tmp_path / "b")
+    trainer.fit(base.replace(steps=8, flat_optimizer=False,
+                             checkpoint_dir=ck), data=tiny_data)
+    out = trainer.fit(base.replace(steps=16, flat_optimizer=True,
+                                   checkpoint_dir=ck), data=tiny_data)
+    assert out["restored"] is True and out["steps"] == 16
+    np.testing.assert_allclose(out["test_accuracy"],
+                               oracle["test_accuracy"], atol=1e-6)
+
+
+def test_unrelated_mismatch_still_raises(tmp_path, eight_devices):
+    """A checkpoint that is NOT a layout variant (different model) still
+    fails loudly with the structure-mismatch diagnostic."""
+    saved = _state(eight_devices, step=1)
+    d = str(tmp_path / "m")
+    ckpt = Checkpointer(d)
+    ckpt.save(1, saved)
+    ckpt.wait()
+    ckpt.close()
+
+    mesh = make_mesh(eight_devices)
+    lenet = models.build("lenet", conv="lax")
+    tx = optim.build("adam", 1e-3)
+    other = jax.device_put(
+        init_state(jax.random.PRNGKey(0), lenet, tx,
+                   jnp.zeros((1, 28, 28, 1))), replicated(mesh))
+    ckpt2 = Checkpointer(d)
+    with pytest.raises(ValueError, match="training-state structure"):
+        ckpt2.maybe_restore(other)
+    ckpt2.close()
 
 
 def test_eval_only_restores_and_reports(tmp_path, tiny_data):

@@ -77,12 +77,37 @@ def test_two_process_checkpoint_kill_resume(tmp_path):
     processes (process 0 commits the directory)."""
     ckpt = str(tmp_path / "mh-ckpt")
     # run 1: both workers crash at step 5 (checkpoint saved at step 3)
-    outs = _launch(2, _free_port(), extra=(ckpt, 5))
+    outs = _launch(2, _free_port(),
+                   extra=("--ckpt-dir", ckpt, "--fail-at", 5))
     assert all("MHFAILED injected" in o for o in outs)
     # run 2: restore at step 3, finish steps 4-6
-    outs = _launch(2, _free_port(), extra=(ckpt,))
+    outs = _launch(2, _free_port(), extra=("--ckpt-dir", ckpt))
     results = [json.loads(r) for r in _results(outs)]
     for r in results:
         assert r["restored"] is True
         assert r["steps"] == 6
     assert results[0]["accuracy"] == results[1]["accuracy"]
+
+
+@pytest.mark.slow
+def test_two_process_streaming_pipeline():
+    """The streaming host pipeline under process_count > 1 — the code path
+    whose entire reason to exist is multi-host scale (BASELINE.json
+    north_star: "per-host tf.data pipeline feeding device-sharded global
+    batches"). Asserts (a) streaming fit ≡ device-resident fit on the same
+    seed, (b) each process host-gathered ONLY rows belonging to its own
+    addressable 'data' shards — no process ever materialized a full global
+    batch (instrumented in the worker)."""
+    outs = _launch(2, _free_port(), extra=("--data-pipeline", "stream"))
+    results = [json.loads(r) for r in _results(outs)]
+    for r in results:
+        assert r["multihost"] is True and r["n_chips"] == 8
+        assert r["stream_steps"] == r["steps"] == 6
+        # (a) trajectory equivalence, device-resident vs streamed
+        assert r["stream_accuracy"] == r["accuracy"]
+        # (b) per-process gather locality
+        assert r["stream_rows_ok"] is True, r
+        assert r["stream_full_batch_avoided"] is True, r
+        assert r["stream_rows_touched"] == r["stream_rows_expected"] > 0
+    # both processes agree on the replicated result
+    assert results[0]["stream_accuracy"] == results[1]["stream_accuracy"]

@@ -120,3 +120,64 @@ def test_bench_time_to_accuracy_contract():
     d = rec["detail"]
     assert d["trials"] == 2 and len(d["trials_s"]) == 2
     assert d["min_s"] <= rec["value"] <= d["max_s"]
+    # trials must run DISTINCT seeds (round-2 verdict: three runs of one
+    # trajectory measure only relay latency) and every seed must reach
+    # the target for vs_baseline to count
+    seeds = [t["seed"] for t in d["trial_results"]]
+    assert len(set(seeds)) == 2
+    assert all(t["reached"] for t in d["trial_results"])
+    assert rec["vs_baseline"] > 0
+
+
+@pytest.mark.slow
+def test_bench_sweep_contract():
+    """The sweep mode's JSON contract — the artifact the 8-chip scaling
+    claim (SWEEP_r*.json) is built from. Runs the real measurement inline
+    on the 8-virtual-device CPU backend, tiny step counts."""
+    rec = _run_bench(["--mode", "sweep", "--sweep-batches", "8,16",
+                      "--bench-steps", "2", "--warmup-steps", "1",
+                      "--repeats", "1", "--model", "mlp"])
+    assert rec["metric"] == "predicted_8chip_images_per_sec_per_chip"
+    d = rec["detail"]
+    assert set(d["curve_img_s_chip"]) == {"8", "16"}
+    for point in d["curve_img_s_chip"].values():
+        assert point["img_s_chip"] > 0 and point["step_ms"] > 0
+    # 8 virtual devices -> the measured step already contains the real
+    # collective; the allreduce model must NOT be stacked on top
+    assert d["n_chips_measured"] == 8
+    assert d["allreduce_modeled"] is False
+    assert d["n_params"] == 101770          # MLP 784-128-10
+    assert d["strong_scaling"]["per_chip_batch"] == 8
+    assert d["weak_scaling"]["per_chip_batch"] == 16
+    # sensitivity band brackets the point estimate for both regimes
+    lo, hi = d["prediction_range"]["strong_img_s_chip"]
+    assert lo <= d["strong_scaling"]["img_s_chip"] <= hi
+    lo, hi = d["prediction_range"]["weak_img_s_chip"]
+    assert lo <= d["weak_scaling"]["img_s_chip"] <= hi
+
+
+@pytest.mark.slow
+def test_bench_smoke_contract():
+    """The smoke gate's JSON contract (SMOKE_r*.json): all legs present,
+    accuracy floor enforced, synthetic data labeled as such."""
+    rec = _run_bench(["--mode", "smoke", "--model", "mlp"])
+    assert rec["metric"] == "tpu_smoke" and rec["value"] == 1.0
+    d = rec["detail"]
+    assert d["legs"] == ["train", "eval", "checkpoint-save",
+                         "restore-resume", "accuracy-floor"]
+    assert d["final_accuracy"] >= 0.85
+    assert d["data"] == "synthetic"
+
+
+@pytest.mark.slow
+def test_bench_smoke_real_data_dir(tmp_path):
+    """--data-dir plumbed through bench (not just trainer.fit): smoke
+    loads REAL-format IDX fixtures and must label the run data=real."""
+    from idx_util import write_idx_fixtures
+
+    from distributedmnist_tpu.data import synthetic_mnist as synth
+    write_idx_fixtures(tmp_path, synth(seed=4, train_n=4096, test_n=1024))
+    rec = _run_bench(["--mode", "smoke", "--model", "mlp",
+                      "--data-dir", str(tmp_path)])
+    assert rec["detail"]["data"] == "real"
+    assert rec["detail"]["final_accuracy"] >= 0.85

@@ -70,22 +70,11 @@ def test_fit_through_real_data_dir(tmp_path):
     reader when the toolchain built it, Python parser otherwise), trained
     to a threshold. If the driver ever mounts real MNIST, this exact path
     produces the real number with no code change."""
-    import os
-    import struct
-
     from distributedmnist_tpu.data import native
+    from idx_util import write_idx_fixtures
 
     src = synthetic_mnist(seed=3, train_n=4096, test_n=1024)
-    names = {"train-images-idx3-ubyte": src["train_x"][..., 0],
-             "train-labels-idx1-ubyte": src["train_y"],
-             "t10k-images-idx3-ubyte": src["test_x"][..., 0],
-             "t10k-labels-idx1-ubyte": src["test_y"]}
-    for name, arr in names.items():
-        dims = arr.shape
-        with open(os.path.join(tmp_path, name), "wb") as f:
-            f.write(struct.pack(f">I{len(dims)}I",
-                                0x0800 | len(dims), *dims))
-            f.write(np.ascontiguousarray(arr, dtype=np.uint8).tobytes())
+    write_idx_fixtures(tmp_path, src)
 
     native.ensure_built()  # exercise the C++ reader where possible
     cfg = BASE.replace(model="mlp", optimizer="sgd", learning_rate=0.02,
