@@ -69,6 +69,11 @@ class Config:
     checkpoint_every: int = 500     # steps between async saves
     resume: bool = True             # restore latest checkpoint if present
     eval_only: bool = False         # restore + evaluate, no training
+    # On SIGTERM (the warning real schedulers deliver before preempting a
+    # worker), stop at the next block boundary and force-save a resumable
+    # checkpoint instead of dropping progress since the last periodic
+    # save. Only active when checkpoint_dir is set.
+    graceful_preemption: bool = True
     # multi-host (config 5)
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -160,6 +165,10 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--no-resume", dest="resume", action="store_false",
                    default=None)
+    p.add_argument("--no-graceful-preemption", dest="graceful_preemption",
+                   action="store_false", default=None,
+                   help="don't catch SIGTERM to force-save a checkpoint "
+                        "before exiting")
     p.add_argument("--eval-only", dest="eval_only", action="store_true",
                    default=None,
                    help="restore from --checkpoint-dir and evaluate; "

@@ -43,6 +43,32 @@ def maybe_initialize(coordinator_address: Optional[str],
             num_processes=num_processes,
             process_id=process_id,
         )
+        return True
+    # Reusing the live rendezvous must not mask a config mismatch: a
+    # second fit() asking for a DIFFERENT topology is a bug, not a
+    # reconnect. The integer topology is checked against the PUBLIC
+    # post-init accessors (they reflect the live rendezvous); a mismatch
+    # is unambiguous — raise. The coordinator string may be normalized by
+    # jax (host resolution) and is only readable from private state, so a
+    # differing string merely warns, best-effort.
+    for name, want, have in (
+            ("num_processes", num_processes, jax.process_count()),
+            ("process_id", process_id, jax.process_index())):
+        if want is not None and want != have:
+            raise ValueError(
+                f"jax.distributed already initialized with {name}={have}; "
+                f"this run asked for {name}={want} — refusing to silently "
+                "reuse a rendezvous with a different topology")
+    try:
+        from jax._src.distributed import global_state as _gs
+        have_addr = getattr(_gs, "coordinator_address", None)
+    except ImportError:  # private module moved; skip the warning only
+        have_addr = None
+    if have_addr is not None and have_addr != coordinator_address:
+        import logging
+        logging.getLogger("distributedmnist_tpu").warning(
+            "reusing live jax.distributed rendezvous at %s (this run "
+            "asked for %s)", have_addr, coordinator_address)
     return True
 
 

@@ -291,7 +291,13 @@ def _pick_steps_per_call(cfg: Config, platform: str, has_ckpt: bool) -> int:
 
 def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     """Run one training workload end-to-end; returns the summary dict whose
-    JSON form is the driver-facing result (SURVEY.md §2 row 11)."""
+    JSON form is the driver-facing result (SURVEY.md §2 row 11).
+
+    With a checkpoint_dir and graceful_preemption (the default), a SIGTERM
+    during training stops the run early and force-saves a resumable
+    checkpoint; the absorbed signal is reported as summary["preempted"],
+    NOT re-delivered. A caller that would run further work after fit()
+    must check that flag and wind down instead."""
     from distributedmnist_tpu.checkpoint import Checkpointer  # lazy: orbax
     from distributedmnist_tpu.utils import enable_compilation_cache
 
@@ -455,64 +461,177 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     def crossed(step_before: int, step_after: int, every: int) -> bool:
         return step_after // every > step_before // every
 
+    # Graceful preemption (SURVEY.md §5 failure recovery, beyond the
+    # --fail-at-step injection): a SIGTERM — the warning real schedulers
+    # deliver before killing a worker — stops training and force-saves a
+    # checkpoint at the exact stopping step instead of dropping progress
+    # since the last periodic save. Installed only when there is a
+    # checkpointer to save with and we're on the main thread
+    # (signal.signal is main-thread-only).
+    #
+    # Single-process: stop at the next block boundary. Multi-process:
+    # Checkpointer.save is a cross-process collective, so a process must
+    # NEVER stop unilaterally on its local signal (the others would hang
+    # in the save barrier, or save a different step). The local flags are
+    # all-gathered at every eval/checkpoint boundary — steps all
+    # processes reach deterministically — and ALL processes stop iff ANY
+    # process was signalled, so the force-save below lines up
+    # process-for-process at the same step. If no boundary remains before
+    # total_steps, the run simply completes — at most eval_every steps
+    # away — with the handler still deferring the signal past the final
+    # force-save.
+    import signal
+    import threading
+    n_proc = jax.process_count()
+    preempt_signum = [None]
+    preempt_agreed = [False]
+    sigterm_installed = False
+    # start_step < total_steps: an eval-only or already-complete run has
+    # no loop to stop and no progress to save — absorbing SIGTERM there
+    # would only make the process immune to termination. (Deterministic
+    # and identical across processes, so the exchange stays symmetric.)
+    install = (ckpt is not None and cfg.graceful_preemption
+               and start_step < total_steps
+               and threading.current_thread() is threading.main_thread())
+    if n_proc > 1:
+        # The per-boundary flag exchange is a collective: every process
+        # must join or none may. Agree ONCE at startup whether all
+        # processes CAN install the handler — a non-main-thread fit() or
+        # --no-graceful-preemption on one host must not leave the others
+        # blocked in an allgather the missing process never joins. This
+        # runs unconditionally under n_proc > 1 for the same reason, and
+        # BEFORE any handler is installed: if the exchange itself raises,
+        # no custom disposition leaks past fit(), and a SIGTERM during
+        # the exchange terminates under the pre-existing disposition
+        # (nothing is saved yet, so that is the right outcome).
+        from jax.experimental import multihost_utils
+        all_capable = bool(multihost_utils.process_allgather(
+            jnp.int32(1 if install else 0)).min())
+        if install and not all_capable:
+            log.warning("graceful preemption disabled: not every process "
+                        "can install the SIGTERM handler")
+        install = install and all_capable
+    if install:
+        def _on_sigterm(signum, frame):
+            preempt_signum[0] = signum
+        prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+        sigterm_installed = True
+
+    def stop_requested() -> bool:
+        if not sigterm_installed:
+            return False
+        if n_proc == 1:
+            return preempt_signum[0] is not None
+        return preempt_agreed[0]
+
     step = start_step
     first_call = True
     try:
-        while step < total_steps:
-            k = min(spc, total_steps - step)  # remainder block recompiles
-                                              # once; only at the very end
-            # Block BEFORE dispatching so at most max_inflight programs are
-            # ever concurrently in flight (cap 1 on CPU really means 1).
-            # Drain via a value fetch: on pooled/relay backends
-            # block_until_ready returns before execution completes
-            # (StepTimer.barrier), which would let queue depth grow
-            # unbounded here.
-            while len(inflight) >= max_inflight:
-                StepTimer.barrier(inflight.popleft())
-            state, metrics = run_block(state, k)
-            inflight.append(metrics["loss"])
-            prev, step = step, step + k
-            if first_call:
-                timer.start(sync=metrics["loss"])  # excludes compile time
-                first_call = False
-            else:
-                timer.lap(k)
-            if cfg.log_every and crossed(prev, step, cfg.log_every):
-                mlog.step(step, {"loss": metrics["loss"],
-                                 "loss_mean": metrics["loss_mean"]})
-
-            if ckpt and crossed(prev, step, cfg.checkpoint_every):
-                with timer.exclude():
-                    ckpt.save(step, state)  # async; overlaps next steps
-
-            if cfg.fail_at_step is not None and step >= cfg.fail_at_step:
-                if ckpt:
-                    ckpt.wait()
-                raise SimulatedFailure(f"injected failure at step {step}")
-
-            if crossed(prev, step, cfg.eval_every) or step == total_steps:
-                accuracy = evaluate(state)
-                mlog.eval(step, accuracy)
-                if (cfg.target_accuracy is not None
-                        and accuracy >= cfg.target_accuracy):
-                    reached_target_at = time.perf_counter() - t_start
-                    log.info("target accuracy %.3f reached at step %d "
-                             "(%.2fs)", cfg.target_accuracy, step,
-                             reached_target_at)
+        try:
+            while step < total_steps:
+                if stop_requested():
+                    log.info("SIGTERM: stopping at step %d to checkpoint",
+                             step)
                     break
+                k = min(spc, total_steps - step)  # remainder block
+                                                  # recompiles once; only
+                                                  # at the very end
+                # Block BEFORE dispatching so at most max_inflight
+                # programs are ever concurrently in flight (cap 1 on CPU
+                # really means 1). Drain via a value fetch: on
+                # pooled/relay backends block_until_ready returns before
+                # execution completes (StepTimer.barrier), which would
+                # let queue depth grow unbounded here.
+                while len(inflight) >= max_inflight:
+                    StepTimer.barrier(inflight.popleft())
+                state, metrics = run_block(state, k)
+                inflight.append(metrics["loss"])
+                prev, step = step, step + k
+                if first_call:
+                    timer.start(sync=metrics["loss"])  # excludes compile
+                    first_call = False
+                else:
+                    timer.lap(k)
+                if cfg.log_every and crossed(prev, step, cfg.log_every):
+                    mlog.step(step, {"loss": metrics["loss"],
+                                     "loss_mean": metrics["loss_mean"]})
+
+                if (sigterm_installed and n_proc > 1
+                        and (crossed(prev, step, cfg.checkpoint_every)
+                             or crossed(prev, step, cfg.eval_every))):
+                    with timer.exclude():
+                        # CPU only: a small host thread pool can deadlock
+                        # concurrent collective programs — drain the
+                        # queued blocks first. TPU pipelines safely; the
+                        # allgather's own value fetch is the only sync,
+                        # so the 16-deep window stays full there.
+                        if devices[0].platform == "cpu":
+                            while inflight:
+                                StepTimer.barrier(inflight.popleft())
+                        from jax.experimental import multihost_utils
+                        flags = multihost_utils.process_allgather(
+                            jnp.int32(0 if preempt_signum[0] is None
+                                      else 1))
+                        preempt_agreed[0] = bool(flags.max())
+
+                if ckpt and crossed(prev, step, cfg.checkpoint_every):
+                    with timer.exclude():
+                        ckpt.save(step, state)  # async; overlaps steps
+
+                if (cfg.fail_at_step is not None
+                        and step >= cfg.fail_at_step):
+                    if ckpt:
+                        ckpt.wait()
+                    raise SimulatedFailure(
+                        f"injected failure at step {step}")
+
+                if crossed(prev, step, cfg.eval_every) \
+                        or step == total_steps:
+                    accuracy = evaluate(state)
+                    mlog.eval(step, accuracy)
+                    if (cfg.target_accuracy is not None
+                            and accuracy >= cfg.target_accuracy):
+                        reached_target_at = time.perf_counter() - t_start
+                        log.info("target accuracy %.3f reached at step "
+                                 "%d (%.2fs)", cfg.target_accuracy, step,
+                                 reached_target_at)
+                        break
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+
+        # On preemption skip the closing eval (a collective — all
+        # processes skip together, every term below being deterministic
+        # or agreed): the grace period between SIGTERM and SIGKILL is for
+        # the checkpoint save, not a test pass. A run that ran to
+        # completion (or stopped on target accuracy) finished its job —
+        # a signal that landed during the final block must not make an
+        # orchestrator requeue it as preempted.
+        preempted = (stop_requested() and step < total_steps
+                     and reached_target_at is None)
+        if accuracy == 0.0 and not preempted:
+            accuracy = evaluate(state)
+        throughput = timer.snapshot(sync=state.params)
+        wall = time.perf_counter() - t_start
+
+        if ckpt:
+            ckpt.save(int(state.step), state, force=True)
+            ckpt.wait()
+            ckpt.close()
     finally:
-        if profiling:
-            jax.profiler.stop_trace()
-
-    if accuracy == 0.0:
-        accuracy = evaluate(state)
-    throughput = timer.snapshot(sync=state.params)
-    wall = time.perf_counter() - t_start
-
-    if ckpt:
-        ckpt.save(int(state.step), state, force=True)
-        ckpt.wait()
-        ckpt.close()
+        # Restored only AFTER the force-save above: a second SIGTERM
+        # during the save must be absorbed by the handler, not kill the
+        # process mid-write under the default disposition. An absorbed
+        # signal is REPORTED (summary["preempted"]), not re-delivered —
+        # re-raising here would kill the process before the summary/JSON
+        # line the save exists to pair with; a caller that runs further
+        # work after fit() must check the flag. signal.getsignal-style
+        # None (a non-Python-installed prior handler) can't be passed
+        # back to signal.signal — fall back to the default disposition.
+        if sigterm_installed:
+            signal.signal(signal.SIGTERM,
+                          prev_sigterm if prev_sigterm is not None
+                          else signal.SIG_DFL)
 
     summary = {
         "model": cfg.model,
@@ -528,6 +647,7 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         "pixel_format": pixel_format,
         "steps": int(state.step),
         "restored": restored,
+        "preempted": preempted,
         "test_accuracy": accuracy,
         "final_loss": (None if metrics is None
                        else float(jax.device_get(metrics["loss"]))),

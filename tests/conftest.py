@@ -30,6 +30,48 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
 
 
+def committed_steps(ckpt_dir: str) -> list:
+    """Step numbers of orbax checkpoints already COMMITTED in ckpt_dir
+    (an in-progress async save lives in a suffixed tmp dir, never an
+    all-digit one). Shared by the preemption tests that poll for 'a
+    periodic save has landed' before signalling a worker."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(d) for d in os.listdir(ckpt_dir) if d.isdigit())
+
+
+def worker_env():
+    """(env, repo_root) for spawning CPU-only worker subprocesses: no TPU
+    relay dial, worker-controlled device count, repo on PYTHONPATH.
+    Shared by every test that launches training workers."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't dial the TPU relay
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return env, repo_root
+
+
+def wait_for_committed_checkpoint(ckpt_dir: str, procs,
+                                  timeout_s: float = 300.0) -> None:
+    """Block until a committed orbax step exists in ckpt_dir — the signal
+    that a worker's training is demonstrably past a periodic save. Fails
+    the test if any worker exits first or the deadline passes."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if committed_steps(ckpt_dir):
+            return
+        for p in procs:
+            assert p.poll() is None, (
+                "worker exited before any checkpoint was committed:\n"
+                + p.communicate()[0][-3000:])
+        time.sleep(0.2)
+    pytest.fail("no checkpoint committed within the deadline")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -10,6 +10,10 @@ that justifies its existence: each process host-gathers ONLY the rows of
 its own addressable 'data' shards, never the full global batch. The worker
 instruments the numpy gather to prove it, and runs the device-resident
 pipeline on the same seed so the test can assert trajectory equivalence.
+--stream-source selects the host-gather backend: 'numpy' (locality-
+instrumented) or 'tfdata' (the north_star's literal per-host tf.data
+pipeline; trajectory equivalence only — it materializes the full block
+per host by documented design).
 """
 
 import argparse
@@ -70,6 +74,9 @@ def main() -> int:
     p.add_argument("--fail-at", type=int, default=None)
     p.add_argument("--data-pipeline", choices=["device", "stream"],
                    default="device")
+    p.add_argument("--stream-source", choices=["numpy", "tfdata"],
+                   default="numpy")
+    p.add_argument("--steps", type=int, default=6)
     args = p.parse_args()
 
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -84,7 +91,8 @@ def main() -> int:
 
     data = synthetic_mnist(seed=1, train_n=1024, test_n=256)
     cfg = Config(model="mlp", optimizer="sgd", learning_rate=0.02,
-                 batch_size=64, steps=6, eval_every=6, device="cpu",
+                 batch_size=64, steps=args.steps, eval_every=6,
+                 device="cpu",
                  synthetic=True, log_every=0, target_accuracy=None,
                  coordinator_address=f"localhost:{args.port}",
                  num_processes=args.num_processes,
@@ -104,6 +112,7 @@ def main() -> int:
         "n_processes": out["n_processes"],
         "multihost": out["multihost"],
         "restored": out["restored"],
+        "preempted": out["preempted"],
     }
 
     if args.data_pipeline == "stream":
@@ -115,20 +124,30 @@ def main() -> int:
             train_x=data["train_x"].view(_TrackingArray),
             train_y=data["train_y"].view(_TrackingArray))
         s_out = trainer.fit(cfg.replace(data_pipeline="stream",
+                                        stream_source=args.stream_source,
                                         checkpoint_dir=None),
                             data=tracked)
-        expected, full = _expected_stream_rows(cfg, data, s_out["steps"])
         result.update({
+            "stream_source": args.stream_source,
             "stream_accuracy": s_out["test_accuracy"],
             "stream_steps": s_out["steps"],
-            "stream_rows_touched": len(_TRACKED_ROWS),
-            "stream_rows_expected": len(expected),
-            # the defining multi-host property: ONLY addressable-shard
-            # rows were ever host-gathered by this process — a strict
-            # subset of what the global batches contained
-            "stream_rows_ok": _TRACKED_ROWS == expected,
-            "stream_full_batch_avoided": len(expected) < len(full),
         })
+        if args.stream_source == "numpy":
+            # Gather locality is a numpy-source property only: tfdata
+            # materializes the full block per host by documented design
+            # (host_loader.py:34-43), so the row instrument applies to
+            # the numpy backend.
+            expected, full = _expected_stream_rows(cfg, data,
+                                                   s_out["steps"])
+            result.update({
+                "stream_rows_touched": len(_TRACKED_ROWS),
+                "stream_rows_expected": len(expected),
+                # the defining multi-host property: ONLY addressable-
+                # shard rows were ever host-gathered by this process — a
+                # strict subset of what the global batches contained
+                "stream_rows_ok": _TRACKED_ROWS == expected,
+                "stream_full_batch_avoided": len(expected) < len(full),
+            })
 
     print("MHRESULT " + json.dumps(result), flush=True)
     return 0

@@ -1,0 +1,40 @@
+"""Worker for the graceful-preemption e2e test: runs a long CPU fit with
+periodic checkpointing. The parent waits for the first committed
+checkpoint, sends SIGTERM, and asserts this process exits cleanly having
+force-saved a resumable checkpoint at its stopping step (trainer.fit's
+graceful_preemption path — SURVEY.md §5 failure recovery)."""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8")
+
+from distributedmnist_tpu import trainer  # noqa: E402
+from distributedmnist_tpu.config import Config  # noqa: E402
+from distributedmnist_tpu.data import synthetic_mnist  # noqa: E402
+
+
+def main() -> int:
+    ckpt_dir, steps = sys.argv[1], int(sys.argv[2])
+    data = synthetic_mnist(seed=0, train_n=1024, test_n=256)
+    cfg = Config(device="cpu", num_devices=8, model="mlp", optimizer="sgd",
+                 learning_rate=0.05, synthetic=True, batch_size=64,
+                 steps=steps, eval_every=10**9, log_every=0,
+                 target_accuracy=None, fused_kernels="xla",
+                 checkpoint_dir=ckpt_dir, checkpoint_every=10)
+    out = trainer.fit(cfg, data=data)
+    print("PREEMPT " + json.dumps({
+        "steps": out["steps"],
+        "preempted": out["preempted"],
+        "restored": out["restored"],
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,6 +151,86 @@ def test_cross_layout_resume_trajectory(tmp_path, tiny_data):
                                oracle["test_accuracy"], atol=1e-6)
 
 
+@pytest.mark.parametrize("target_flat", [True, False])
+def test_coincidental_flat_sized_leaf_not_converted(tmp_path,
+                                                    eight_devices,
+                                                    target_flat):
+    """A checkpoint whose opt_state merely CONTAINS a 1-D leaf of the
+    total-param size — but is not the flat optimizer layout — must fail
+    loudly, not be silently 'converted' from garbage: the structural
+    fingerprint gate consults the checkpoint's own tree metadata before
+    any conversion (round-3 verdict, weak #4)."""
+    base = _state(eight_devices, step=4)
+    flat_size = sum(np.asarray(l).size
+                    for l in jax.tree.leaves(base.params))
+    mesh = make_mesh(eight_devices)
+    weird = base.replace(opt_state={
+        "scale": jax.device_put(
+            jnp.arange(flat_size, dtype=jnp.float32), replicated(mesh)),
+    })
+    d = str(tmp_path / "w")
+    ckpt = Checkpointer(d)
+    ckpt.save(4, weird)
+    ckpt.wait()
+    ckpt.close()
+
+    target = _state(eight_devices, step=0, flat=target_flat)
+    ckpt2 = Checkpointer(d)
+    with pytest.raises(ValueError, match="training-state structure"):
+        ckpt2.maybe_restore(target)
+    ckpt2.close()
+
+
+def test_cross_layout_restore_takes_saved_moment_dtypes(tmp_path,
+                                                        eight_devices):
+    """The layout conversion reads each moment's dtype from the
+    checkpoint's metadata POSITIONALLY, not from the params and not by
+    shape lookup: a flat checkpoint with MIXED moment dtypes (mu cast to
+    bfloat16, nu kept float32 — the optax mu_dtype pattern) restores
+    into an f32 per-leaf target with every value preserved and the
+    target's dtypes applied (round-3 advice + review)."""
+    saved = _state(eight_devices, step=5, flat=True)
+    flat_size = sum(np.asarray(l).size
+                    for l in jax.tree.leaves(saved.params))
+
+    seen = [0]
+
+    def cast(l):
+        if l.ndim == 1 and l.size == flat_size:
+            seen[0] += 1
+            if seen[0] == 1:  # mu (first flat moment) only; nu stays f32
+                return l.astype(jnp.bfloat16)
+        return l
+    saved = saved.replace(opt_state=jax.tree.map(cast, saved.opt_state))
+    assert seen[0] == 2  # adam: exactly mu and nu
+    d = str(tmp_path / "bd")
+    ckpt = Checkpointer(d)
+    ckpt.save(5, saved)
+    ckpt.wait()
+    ckpt.close()
+
+    target = _state(eight_devices, step=0, flat=False)
+    ckpt2 = Checkpointer(d)
+    restored, ok = ckpt2.maybe_restore(target)
+    ckpt2.close()
+    assert ok and int(restored.step) == 5
+    # target layout and dtypes: per-leaf f32 moments
+    assert (jax.tree.structure(restored.opt_state)
+            == jax.tree.structure(target.opt_state))
+    moments = [l for l in jax.tree.leaves(restored.opt_state)
+               if hasattr(l, "dtype") and l.ndim > 0]
+    assert moments and all(l.dtype == jnp.float32 for l in moments)
+    # saved values positionally intact: [mu (bf16->f32), nu (exact f32)]
+    mu, nu = [l for l in jax.tree.leaves(saved.opt_state)
+              if getattr(l, "ndim", 0) == 1 and l.size == flat_size]
+    expected = np.concatenate([
+        np.asarray(mu.astype(jnp.float32)).ravel(),
+        np.asarray(nu).ravel()])
+    restored_vec = np.concatenate(
+        [np.asarray(l).ravel() for l in moments])
+    np.testing.assert_array_equal(restored_vec, expected)
+
+
 def test_unrelated_mismatch_still_raises(tmp_path, eight_devices):
     """A checkpoint that is NOT a layout variant (different model) still
     fails loudly with the structure-mismatch diagnostic."""

@@ -5,11 +5,14 @@ assembly, cross-process psum."""
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
 
 import pytest
+
+from conftest import wait_for_committed_checkpoint, worker_env
 
 
 def _free_port() -> int:
@@ -18,15 +21,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(n, port, extra=()):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't dial the TPU relay
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # worker sets its own device count
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+def _spawn(n, port, extra=()):
+    env, repo_root = worker_env()
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
-    procs = [
+    return [
         subprocess.Popen(
             [sys.executable, worker, str(i), str(n), str(port),
              *map(str, extra)],
@@ -34,6 +32,10 @@ def _launch(n, port, extra=()):
             text=True, env=env, cwd=repo_root)
         for i in range(n)
     ]
+
+
+def _launch(n, port, extra=()):
+    procs = _spawn(n, port, extra)
     outs = []
     try:
         for p in procs:
@@ -90,24 +92,75 @@ def test_two_process_checkpoint_kill_resume(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_streaming_pipeline():
+def test_two_process_sigterm_preemption(tmp_path):
+    """Graceful preemption under process_count > 1: SIGTERM delivered to
+    ONE process must stop BOTH at the same checkpoint-boundary step (the
+    local flags are all-gathered there — a unilateral stop would deadlock
+    the collective force-save), both must exit cleanly having saved the
+    same step, and a fresh 2-process run must restore it and finish."""
+    ckpt = str(tmp_path / "mh-pre")
+    procs = _spawn(2, _free_port(),
+                   extra=("--ckpt-dir", ckpt, "--steps", "100000"))
+    outs = []
+    try:
+        wait_for_committed_checkpoint(ckpt, procs)
+        procs[0].send_signal(signal.SIGTERM)  # process 0 ONLY
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    results = [json.loads(r) for r in _results(outs)]
+    for r in results:
+        assert r["preempted"] is True
+        assert 0 < r["steps"] < 100000
+    # the agreed stop step is identical across processes — the property
+    # that makes the collective force-save line up instead of deadlock
+    assert results[0]["steps"] == results[1]["steps"]
+    saved_step = results[0]["steps"]
+
+    # a fresh 2-process run restores the preemption save and finishes
+    outs = _launch(2, _free_port(),
+                   extra=("--ckpt-dir", ckpt,
+                          "--steps", str(saved_step + 6)))
+    results = [json.loads(r) for r in _results(outs)]
+    for r in results:
+        assert r["restored"] is True
+        assert r["preempted"] is False
+        assert r["steps"] == saved_step + 6
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("source", ["numpy", "tfdata"])
+def test_two_process_streaming_pipeline(source):
     """The streaming host pipeline under process_count > 1 — the code path
     whose entire reason to exist is multi-host scale (BASELINE.json
     north_star: "per-host tf.data pipeline feeding device-sharded global
-    batches"). Asserts (a) streaming fit ≡ device-resident fit on the same
-    seed, (b) each process host-gathered ONLY rows belonging to its own
-    addressable 'data' shards — no process ever materialized a full global
-    batch (instrumented in the worker)."""
-    outs = _launch(2, _free_port(), extra=("--data-pipeline", "stream"))
+    batches"), under BOTH host-gather backends. Asserts (a) streaming fit
+    ≡ device-resident fit on the same seed, (b) for the numpy source,
+    each process host-gathered ONLY rows belonging to its own addressable
+    'data' shards — no process ever materialized a full global batch
+    (instrumented in the worker; tfdata materializes the full block per
+    host by documented design, so (b) is numpy-only)."""
+    outs = _launch(2, _free_port(),
+                   extra=("--data-pipeline", "stream",
+                          "--stream-source", source))
     results = [json.loads(r) for r in _results(outs)]
     for r in results:
         assert r["multihost"] is True and r["n_chips"] == 8
+        assert r["stream_source"] == source
         assert r["stream_steps"] == r["steps"] == 6
         # (a) trajectory equivalence, device-resident vs streamed
         assert r["stream_accuracy"] == r["accuracy"]
-        # (b) per-process gather locality
-        assert r["stream_rows_ok"] is True, r
-        assert r["stream_full_batch_avoided"] is True, r
-        assert r["stream_rows_touched"] == r["stream_rows_expected"] > 0
+        if source == "numpy":
+            # (b) per-process gather locality
+            assert r["stream_rows_ok"] is True, r
+            assert r["stream_full_batch_avoided"] is True, r
+            assert (r["stream_rows_touched"]
+                    == r["stream_rows_expected"] > 0)
     # both processes agree on the replicated result
     assert results[0]["stream_accuracy"] == results[1]["stream_accuracy"]
