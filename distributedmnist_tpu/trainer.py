@@ -424,9 +424,28 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     idx_mat = distributed.put_global(idx_mat, eval_spec)
     mask_mat = distributed.put_global(mask_mat, eval_spec)
 
+    def drain_inflight() -> None:
+        """Finish (and COUNT) every queued training block before entering
+        an excluded span. Device programs execute in order, so an eval/
+        checkpoint/allgather fetch inside timer.exclude() would otherwise
+        wait out the queued blocks' device time there — silently moving
+        real training compute into `excluded` and inflating the reported
+        throughput (observed: a 16-blocks-in-flight run whose only eval
+        sat at the end reported a physically impossible img/s). One fetch
+        of the NEWEST block suffices: blocks chain through the donated
+        state, so its value covers every queued predecessor (the same
+        argument bench.py's closing fetch rests on) — fetching each block
+        separately would charge one relay round-trip per block."""
+        if inflight:
+            StepTimer.barrier(inflight[-1])
+            inflight.clear()
+
     def evaluate(state) -> float:
         # Inside timer.exclude(): eval seconds must not deflate the
-        # training-throughput metric (the BASELINE headline number).
+        # training-throughput metric (the BASELINE headline number) —
+        # but the queued TRAIN blocks ahead of it must finish on the
+        # counted clock first.
+        drain_inflight()
         with timer.exclude():
             correct = eval_fn(state.params, ds.test_x, ds.test_y,
                               idx_mat, mask_mat)
@@ -559,15 +578,12 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
                 if (sigterm_installed and n_proc > 1
                         and (crossed(prev, step, cfg.checkpoint_every)
                              or crossed(prev, step, cfg.eval_every))):
+                    # Drain first (counted): programs run in order, so
+                    # the allgather's value fetch waits out the queued
+                    # blocks anyway — and on CPU the collective must not
+                    # race them in a small host thread pool.
+                    drain_inflight()
                     with timer.exclude():
-                        # CPU only: a small host thread pool can deadlock
-                        # concurrent collective programs — drain the
-                        # queued blocks first. TPU pipelines safely; the
-                        # allgather's own value fetch is the only sync,
-                        # so the 16-deep window stays full there.
-                        if devices[0].platform == "cpu":
-                            while inflight:
-                                StepTimer.barrier(inflight.popleft())
                         from jax.experimental import multihost_utils
                         flags = multihost_utils.process_allgather(
                             jnp.int32(0 if preempt_signum[0] is None
@@ -575,8 +591,13 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
                         preempt_agreed[0] = bool(flags.max())
 
                 if ckpt and crossed(prev, step, cfg.checkpoint_every):
+                    # Same attribution rule: the save's device->host
+                    # copy waits for the queued blocks' state; finish
+                    # them on the counted clock, exclude only the copy
+                    # (the disk write still overlaps training — async).
+                    drain_inflight()
                     with timer.exclude():
-                        ckpt.save(step, state)  # async; overlaps steps
+                        ckpt.save(step, state)
 
                 if (cfg.fail_at_step is not None
                         and step >= cfg.fail_at_step):
