@@ -409,15 +409,20 @@ def _sweep(args) -> int:
     # Strong scaling: global batch fixed at 8x the SMALLEST per-chip
     # batch (config 4's global 512 = 64/chip on 8 chips) — the per-chip
     # step is overhead-dominated there, so speedup is sub-linear.
-    smallest, largest = min(curve), max(curve)
+    smallest = min(curve)
     strong_step_ms = curve[smallest]["step_ms"] + modeled_ms
     strong_img_s_chip = smallest / strong_step_ms * 1e3
-    # Weak scaling: per-chip batch held at the LARGEST measured size; the
-    # only 8-chip overhead is the allreduce, so efficiency is near 1 —
-    # the north_star's "near-linear images/sec scaling to 8 chips".
-    weak_step_ms = curve[largest]["step_ms"] + modeled_ms
-    weak_img_s_chip = largest / weak_step_ms * 1e3
-    weak_eff = weak_img_s_chip / curve[largest]["img_s_chip"]
+    # Weak scaling: per-chip batch held at the curve's PEAK — the
+    # operating point a user should run — not the largest measured size:
+    # the curve bends down once the conv backward goes HBM-bound
+    # (BASELINE.md "knee"), and anchoring past the knee would report the
+    # worst point as "the" weak-scaling number. The only 8-chip overhead
+    # at the peak is the allreduce, so efficiency is near 1 — the
+    # north_star's "near-linear images/sec scaling to 8 chips".
+    peak = max(curve, key=lambda b: curve[b]["img_s_chip"])
+    weak_step_ms = curve[peak]["step_ms"] + modeled_ms
+    weak_img_s_chip = peak / weak_step_ms * 1e3
+    weak_eff = weak_img_s_chip / curve[peak]["img_s_chip"]
 
     # Sensitivity band (round-2 verdict, weak #3): the prediction rests on
     # two transferred quantities — the modeled allreduce and the 1-chip
@@ -434,7 +439,7 @@ def _sweep(args) -> int:
 
     prediction_range = {
         "strong_img_s_chip": _band(curve[smallest]["step_ms"], smallest),
-        "weak_img_s_chip": _band(curve[largest]["step_ms"], largest),
+        "weak_img_s_chip": _band(curve[peak]["step_ms"], peak),
         "grid": {"allreduce_x": [1, 2], "fixed_cost_x": [0.8, 1.0, 1.2]},
     }
     value = strong_img_s_chip
@@ -462,8 +467,8 @@ def _sweep(args) -> int:
                 "global_img_s": round(8 * strong_img_s_chip, 1),
             },
             "weak_scaling": {
-                "per_chip_batch": largest,
-                "global_batch_8chip": 8 * largest,
+                "per_chip_batch": peak,
+                "global_batch_8chip": 8 * peak,
                 "step_ms": round(weak_step_ms, 4),
                 "img_s_chip": round(weak_img_s_chip, 1),
                 "global_img_s": round(8 * weak_img_s_chip, 1),

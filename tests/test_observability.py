@@ -148,7 +148,11 @@ def test_bench_sweep_contract():
     assert d["allreduce_modeled"] is False
     assert d["n_params"] == 101770          # MLP 784-128-10
     assert d["strong_scaling"]["per_chip_batch"] == 8
-    assert d["weak_scaling"]["per_chip_batch"] == 16
+    # weak scaling anchors at the measured curve's PEAK (the operating
+    # point), whichever batch that was on this run
+    peak = max(d["curve_img_s_chip"],
+               key=lambda k: d["curve_img_s_chip"][k]["img_s_chip"])
+    assert str(d["weak_scaling"]["per_chip_batch"]) == peak
     # sensitivity band brackets the point estimate for both regimes
     lo, hi = d["prediction_range"]["strong_img_s_chip"]
     assert lo <= d["strong_scaling"]["img_s_chip"] <= hi
