@@ -47,6 +47,14 @@ import time
 TARGET_IPS_PER_CHIP = 2500.0
 TARGET_WALL_S = 30.0
 
+# The tuned time-to-accuracy recipe's cosine decay horizon, in steps —
+# pinned to the horizon the 5-seed LR grid was collected under (20 epochs
+# x 117 steps/epoch at global batch 512 on the 60k-row task). Without the
+# pin, trainer.fit derives decay_steps from epochs x steps_per_epoch, so
+# the --max-epochs trial-BUDGET knob would silently reshape the LR curve
+# the tuning evidence justifies (round-4 verdict, weak #2).
+TTA_DECAY_STEPS = 2340
+
 
 def _mark(msg: str) -> None:
     """Progress marker on stderr — the supervisor's liveness signal."""
@@ -206,6 +214,11 @@ class _Runner:
         from distributedmnist_tpu.utils import enable_compilation_cache
 
         enable_compilation_cache()
+        # Recorded BEFORE the mode functions resolve the default: an
+        # explicit --bench-steps is honored exactly; the default window
+        # scales with the (possibly auto-deepened) block size so the
+        # bounded in-flight cap always genuinely binds mid-window.
+        self.user_bench_steps = args.bench_steps is not None
         self.devs = jax.devices()
         _mark(f"backend up: {len(self.devs)}x {self.devs[0].platform}")
         self.n_chips = len(self.devs)
@@ -246,9 +259,38 @@ class _Runner:
                                   dtype=self.dtype,
                                   pixel_format="packed")
         stream = IndexStream(self.ds.train_n, gb, seed=0, mesh=self.mesh)
-        spc = (max(1, args.steps_per_call)
-               if args.steps_per_call is not None
-               else (1 if self.sync_every_step else 256))
+        # Auto-deepened dispatch blocks at small per-chip batch: the fixed
+        # per-block cost (dispatch + the relay round-trip of each drain/
+        # closing fetch) is amortized over spc steps, and at b=64/chip a
+        # 256-step block's device time (~55 ms) sits BELOW one relay RTT
+        # (~140 ms) — the round-4 sweep measured b=64 slower PER STEP than
+        # b=128 purely from that fixed cost (SWEEP_r04.json, verdict weak
+        # #1). Scaling spc to hold per-chip images/block constant
+        # (256 steps x 512 rows) keeps every batch size's block above the
+        # RTT floor; the scan body compiles once regardless of k, so
+        # deeper blocks cost no extra compile. Each curve point RECORDS
+        # its steps_per_call. Note production fit()'s AUTO depth is
+        # additionally capped by the eval/checkpoint cadence
+        # (trainer._pick_steps_per_call — block edges must land on eval
+        # steps), so a cadence-200 training run at small batch cannot
+        # reach this depth automatically; the --steps-per-call knob can,
+        # and the sweep measures what the hardware does at each batch
+        # under the depth a throughput-minded user would pick.
+        if args.steps_per_call is not None:
+            spc = max(1, args.steps_per_call)
+        elif self.sync_every_step:
+            spc = 1
+        else:
+            per_chip_b = max(1, gb // self.n_chips)
+            spc = min(2048, 256 * max(1, 512 // per_chip_b))
+        # Keep the production queueing regime honest under deepened
+        # blocks (round-2 verdict, weak #5): the DEFAULT timed window
+        # always spans 32 blocks — twice the 16-deep in-flight cap — so
+        # the cap genuinely binds for the second half of every window
+        # regardless of spc. An explicit --bench-steps is honored as
+        # given (the CPU contract tests rely on tiny exact windows).
+        if not self.user_bench_steps and not self.sync_every_step:
+            bench_steps = max(bench_steps, 32 * spc)
 
         state_box = [state]
 
@@ -258,9 +300,10 @@ class _Runner:
         # queueing regime production training runs — not a deeper,
         # slightly more favorable one (round-2 verdict, weak #5). For
         # the cap to actually bind mid-window the timed window must span
-        # more than max_inflight blocks — the default TPU window (8192
-        # steps = 32 blocks of 256) does; blocks 17..32 each wait on the
-        # oldest in-flight result before dispatching.
+        # more than max_inflight blocks — the default TPU window is
+        # scaled to 32 blocks (= 2x the cap) above, whatever spc is;
+        # blocks 17..32 each wait on the oldest in-flight result before
+        # dispatching.
         from collections import deque
 
         from distributedmnist_tpu.utils import StepTimer
@@ -331,10 +374,12 @@ def _throughput(args) -> int:
 
     r = _Runner(args)
     gb = round_up(args.global_batch, r.n_chips)
-    # 8192-step windows amortize the closing value fetch (~140 ms on the
-    # relay) to <0.02 ms/step AND span 32 blocks of 256 — twice the
-    # 16-deep inflight cap, so the production queueing barrier genuinely
-    # fires for the second half of every window (round-3 advice).
+    # >=8192-step windows amortize the closing value fetch (~140 ms on
+    # the relay) to <0.02 ms/step; measure() additionally scales the
+    # default window to 32 blocks — twice the 16-deep inflight cap — so
+    # the production queueing barrier genuinely fires for the second
+    # half of every window (round-3 advice) even when the block size is
+    # auto-deepened at small per-chip batch.
     if args.bench_steps is None:
         args.bench_steps = 64 if r.sync_every_step else 8192
     m = r.measure(args, gb, args.bench_steps)
@@ -376,14 +421,17 @@ def _sweep(args) -> int:
     for b in args.sweep_batches:
         # b is the PER-CHIP batch; the measured global batch scales with
         # the visible chips so the curve means the same thing on a 1-chip
-        # and an 8-chip host. A CONSTANT step count per batch size keeps
-        # the closing value fetch identically amortized across the curve
-        # (fewer steps at small b would inflate exactly the small-batch
-        # step_ms the strong-scaling prediction is computed from).
+        # and an 8-chip host. Every point runs the same 32-block window
+        # shape (measure() scales the default step count with the
+        # auto-deepened block size), so the closing value fetch and the
+        # in-flight cap behave identically across the curve instead of
+        # taxing the small-batch points the strong-scaling prediction is
+        # computed from.
         gb = b * r.n_chips
         m = r.measure(args, gb, args.bench_steps)
         curve[b] = {"img_s_chip": round(m["img_s_chip"], 1),
-                    "step_ms": round(m["step_ms"], 4)}
+                    "step_ms": round(m["step_ms"], 4),
+                    "steps_per_call": m["steps_per_call"]}
 
     # Gradient allreduce cost model (f32 grads, ring allreduce over ICI):
     # bytes on the wire per chip ~= 2 * grad_bytes * (n-1)/n.
@@ -419,10 +467,25 @@ def _sweep(args) -> int:
     # worst point as "the" weak-scaling number. The only 8-chip overhead
     # at the peak is the allreduce, so efficiency is near 1 — the
     # north_star's "near-linear images/sec scaling to 8 chips".
+    # Both anchors are REPORTED (round-4 advice): the peak is the
+    # headline (the operating point), and the fixed largest-batch block
+    # sits alongside it so a noisy argmax can't silently move the number
+    # a reader compares across rounds.
     peak = max(curve, key=lambda b: curve[b]["img_s_chip"])
-    weak_step_ms = curve[peak]["step_ms"] + modeled_ms
-    weak_img_s_chip = peak / weak_step_ms * 1e3
-    weak_eff = weak_img_s_chip / curve[peak]["img_s_chip"]
+    largest = max(curve)
+
+    def _weak_block(b: int) -> dict:
+        step_ms = curve[b]["step_ms"] + modeled_ms
+        img_s_chip = b / step_ms * 1e3
+        return {
+            "per_chip_batch": b,
+            "global_batch_8chip": 8 * b,
+            "step_ms": round(step_ms, 4),
+            "img_s_chip": round(img_s_chip, 1),
+            "global_img_s": round(8 * img_s_chip, 1),
+            "efficiency_vs_1chip": round(
+                img_s_chip / curve[b]["img_s_chip"], 4),
+        }
 
     # Sensitivity band (round-2 verdict, weak #3): the prediction rests on
     # two transferred quantities — the modeled allreduce and the 1-chip
@@ -466,14 +529,9 @@ def _sweep(args) -> int:
                 "img_s_chip": round(strong_img_s_chip, 1),
                 "global_img_s": round(8 * strong_img_s_chip, 1),
             },
-            "weak_scaling": {
-                "per_chip_batch": peak,
-                "global_batch_8chip": 8 * peak,
-                "step_ms": round(weak_step_ms, 4),
-                "img_s_chip": round(weak_img_s_chip, 1),
-                "global_img_s": round(8 * weak_img_s_chip, 1),
-                "efficiency_vs_1chip": round(weak_eff, 4),
-            },
+            "weak_scaling": {"anchor": "peak", **_weak_block(peak)},
+            "weak_scaling_at_largest": {"anchor": "largest",
+                                        **_weak_block(largest)},
             "prediction_range": prediction_range,
         },
     }))
@@ -532,12 +590,54 @@ def _smoke(args) -> int:
             "legs": legs,
             "final_accuracy": round(out2["test_accuracy"], 4),
             # out1's number: the resume run fits in a single dispatch
-            # block, which never opens a throughput window.
+            # block, which never opens a throughput window. It is a
+            # 64-step window dominated by in-loop eval/checkpoint fetch
+            # boundaries — an order of magnitude BELOW the steady-state
+            # number (THROUGHPUT_r*.json); the caveat fields mark it so
+            # nobody diffs it against the real benchmark (round-4
+            # verdict, weak #4).
             "images_per_sec_per_chip":
                 round(out1["images_per_sec_per_chip"], 1),
+            "short_window": True,
+            "window_steps": 64,
         },
     }))
     return 0
+
+
+def tta_config(args, gb: int):
+    """The tuned time-to-accuracy recipe as a Config. Module-level (and
+    contract-tested) so the recipe's invariants are inspectable: the LR
+    and decay horizon are PINNED to the values the tuning evidence was
+    collected under, independent of the --max-epochs trial budget.
+
+    LR tuned on the calibrated task across 5 seeds (grid 2e-3..1e-2):
+    6e-3 crosses 99% in 200-600 steps on EVERY seed where 2e-3 needed
+    400-800 (8e-3 is no faster in total; 1e-2 goes high-variance). The
+    eval cadence stays 200: an eval costs a full device->host fetch
+    (~140 ms on the relay) while 100 train steps cost ~49 ms, so a finer
+    cadence pays more in extra evals than it saves in earlier detection.
+    The cosine horizon is pinned at TTA_DECAY_STEPS — --max-epochs bounds
+    how long a trial may RUN, not how fast the LR decays."""
+    from distributedmnist_tpu.config import Config
+
+    # Budget: --max-epochs, but never past the pinned horizon — beyond
+    # TTA_DECAY_STEPS the cosine has fully decayed to lr=0 and further
+    # steps cannot converge, only burn relay time. The cap is computed
+    # from the 60k-row task the recipe is tuned for; a custom --data-dir
+    # (unknown row count) keeps the plain epochs budget.
+    steps = None
+    if args.data_dir is None:
+        steps = min(args.max_epochs * (60_000 // gb), TTA_DECAY_STEPS)
+    return Config(model=args.model, optimizer="adam", learning_rate=6e-3,
+                  lr_schedule="cosine", lr_decay_steps=TTA_DECAY_STEPS,
+                  data_dir=args.data_dir, synthetic=args.data_dir is None,
+                  batch_size=gb,
+                  epochs=args.max_epochs, steps=steps,
+                  eval_every=200, log_every=0,
+                  target_accuracy=args.target_accuracy,
+                  steps_per_call=args.steps_per_call,
+                  dtype=args.dtype)
 
 
 def _time_to_accuracy(args) -> int:
@@ -547,7 +647,6 @@ def _time_to_accuracy(args) -> int:
     import jax
 
     from distributedmnist_tpu import trainer
-    from distributedmnist_tpu.config import Config
     from distributedmnist_tpu.utils import round_up
 
     # fit()'s INFO eval/summary lines double as the supervisor's liveness
@@ -558,22 +657,7 @@ def _time_to_accuracy(args) -> int:
     n_chips = len(devs)
     _mark(f"backend up: {n_chips} devices")
     gb = round_up(args.global_batch, n_chips)
-    # LR tuned on the calibrated task across 5 seeds (grid 2e-3..1e-2):
-    # 6e-3 crosses 99% in 200-600 steps on EVERY seed where 2e-3 needed
-    # 400-800 (8e-3 is no faster in total; 1e-2 goes high-variance). The
-    # eval cadence stays 200: an eval costs a full device->host fetch
-    # (~140 ms on the relay) while 100 train steps cost ~49 ms, so a
-    # finer cadence pays more in extra evals than it saves in
-    # earlier detection.
-    cfg = Config(model=args.model, optimizer="adam", learning_rate=6e-3,
-                 lr_schedule="cosine",
-                 data_dir=args.data_dir, synthetic=args.data_dir is None,
-                 batch_size=gb,
-                 epochs=args.max_epochs,
-                 eval_every=200, log_every=0,
-                 target_accuracy=args.target_accuracy,
-                 steps_per_call=args.steps_per_call,
-                 dtype=args.dtype)
+    cfg = tta_config(args, gb)
     # Repeated full trials, median reported: a single run's wall-clock has
     # multi-x run-to-run spread on a tunneled backend (relay latency), so
     # one sample would make the recorded number a lottery. Trial 1 pays
@@ -604,7 +688,8 @@ def _time_to_accuracy(args) -> int:
         steps_list.append(out["steps"])
         trial_results.append({
             "seed": seed, "wall_s": round(walls[-1], 2),
-            "steps": out["steps"], "reached": reached,
+            "steps": out["steps"], "evals": out["n_evals"],
+            "reached": reached,
             "final_accuracy": round(out["test_accuracy"], 4)})
         _mark(f"trial {t + 1}/{trials} (seed {seed}): {walls[-1]:.2f}s "
               f"(reached={reached})")
@@ -624,6 +709,23 @@ def _time_to_accuracy(args) -> int:
             "target_accuracy": args.target_accuracy,
             "trials": trials,
             "trials_s": [round(w, 2) for w in walls],
+            # The REPRODUCIBLE primary: wall seconds swing multi-x with
+            # relay weather (same code measured 1.49 s and 2.87 s hours
+            # apart — BASELINE.md), but the step/eval counts a seed needs
+            # to reach target are properties of the code + recipe. A
+            # consumer comparing rounds should compare these. REACHED
+            # trials only: a budget-exhausted trial's step count is the
+            # budget constant, not a time-to-target, and must not
+            # contaminate the median (null when no trial reached).
+            "steps_to_target_median": (
+                int(statistics.median(
+                    [s for s, r in zip(steps_list, reached_flags) if r]))
+                if any(reached_flags) else None),
+            "steps_to_target": [s for s, r
+                                in zip(steps_list, reached_flags) if r],
+            "evals_to_target": [t["evals"] for t in trial_results
+                                if t["reached"]],
+            "wall_s_is_weather_dependent": True,
             "trial_results": trial_results,
             "min_s": round(min(walls), 2),
             "max_s": round(max(walls), 2),

@@ -121,8 +121,15 @@ class Checkpointer:
             saved_tree = self.mgr.item_metadata(step).tree
             saved_opt = saved_tree["opt_state"]
             saved_params = saved_tree["params"]
-        except Exception:  # metadata shape varies across orbax versions;
-            pass           # the restore below still validates structure
+        except Exception as e:  # metadata shape varies across orbax
+            # versions; the restore below still validates structure —
+            # but LOUDLY: without metadata the shape-fingerprint gate is
+            # disabled and conversion falls back to orbax's own
+            # structural validation only (round-4 advice).
+            log.warning(
+                "checkpoint metadata unavailable (%s: %s); the "
+                "layout-conversion fingerprint gate is disabled for "
+                "this restore", type(e).__name__, e)
 
         def _key_str(k) -> str:
             for attr in ("key", "name", "idx"):  # DictKey / GetAttrKey /
@@ -130,17 +137,23 @@ class Checkpointer:
                     return str(getattr(k, attr))
             return str(k)
 
+        def _path_of(path) -> tuple:
+            return tuple(_key_str(k) for k in path)
+
         def fingerprint(tree) -> list:
-            # (normalized key path, shape) per leaf, in flatten order.
-            # Dict keys (the saved metadata tree) and namedtuple fields
-            # (the live optax state) normalize to the same strings, so
-            # equality means leaf-for-leaf CORRESPONDENCE — which is what
-            # licenses the positional dtype mapping below. Shapes alone
-            # would be order-blind exactly where it matters: mu and nu
-            # always share a shape.
+            # SORTED (normalized key path, shape) per leaf. Dict keys
+            # (the saved metadata tree) and namedtuple fields (the live
+            # optax state) normalize to the same strings, so equality
+            # means leaf-for-leaf correspondence BY PATH. Sorting makes
+            # the comparison flatten-order-independent: dicts flatten
+            # sorted-by-key while namedtuples flatten in declaration
+            # order, and adam/sgd fields being alphabetical today is a
+            # coincidence the gate must not lean on (round-4 advice).
+            # Shapes alone would be order-blind exactly where it
+            # matters: mu and nu always share a shape.
             flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-            return [(tuple(_key_str(k) for k in path), tuple(leaf.shape))
-                    for path, leaf in flat]
+            return sorted((_path_of(path), tuple(leaf.shape))
+                          for path, leaf in flat)
 
         if saved_params is not None and fingerprint(saved_params) != \
                 fingerprint(params_abs):
@@ -190,13 +203,20 @@ class Checkpointer:
             if fingerprint(saved_opt) != fingerprint(src_opt):
                 return None
             # ... and then each hypothesis leaf reads with the dtype the
-            # checkpoint actually holds at that position.
-            src_def = jax.tree.structure(src_opt)
+            # checkpoint actually holds at the SAME KEY PATH (not the
+            # same flatten position — the two trees may flatten in
+            # different orders; the fingerprint match above guarantees
+            # the path sets coincide).
+            saved_dtypes = {
+                _path_of(path): np.dtype(leaf.dtype)
+                for path, leaf
+                in jax.tree_util.tree_flatten_with_path(saved_opt)[0]}
+            src_flat, src_def = jax.tree_util.tree_flatten_with_path(
+                src_opt)
             src_opt = jax.tree.unflatten(src_def, [
-                jax.ShapeDtypeStruct(h.shape, np.dtype(s.dtype),
+                jax.ShapeDtypeStruct(h.shape, saved_dtypes[_path_of(path)],
                                      sharding=h.sharding)
-                for h, s in zip(jax.tree.leaves(src_opt),
-                                jax.tree.leaves(saved_opt))])
+                for path, h in src_flat])
 
         src_abstract = abstract.replace(opt_state=src_opt)
         try:

@@ -27,6 +27,12 @@ class Config:
     momentum: float = 0.9           # used by sgd only
     lr_schedule: str = "constant"   # {constant, cosine, warmup-cosine}
     warmup_steps: int = 0
+    # cosine decay horizon in steps. None = the run's own total step count
+    # (epochs x steps_per_epoch, or --steps). Pinning it decouples the LR
+    # schedule from the trial-budget knobs: a tuned recipe keeps the exact
+    # decay curve its evidence was collected under even when --max-epochs/
+    # --steps change (bench.py time-to-accuracy pins this).
+    lr_decay_steps: Optional[int] = None
     # data
     data_dir: Optional[str] = None  # dir with IDX (*-ubyte[.gz]) or mnist.npz
     synthetic: bool = False         # force deterministic synthetic MNIST
@@ -141,6 +147,9 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    choices=["constant", "cosine", "warmup-cosine"],
                    default=None)
     p.add_argument("--warmup-steps", type=int, default=None)
+    p.add_argument("--lr-decay-steps", type=int, default=None,
+                   help="pin the cosine decay horizon (steps); default "
+                        "is the run's own total step count")
     p.add_argument("--data-dir", default=None)
     p.add_argument("--synthetic", action="store_true", default=None)
     p.add_argument("--data-pipeline", choices=["device", "stream"],

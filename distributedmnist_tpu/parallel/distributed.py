@@ -31,9 +31,13 @@ def maybe_initialize(coordinator_address: Optional[str],
                      process_id: Optional[int]) -> bool:
     """Rendezvous with the other hosts iff multi-host flags are present.
 
-    Returns True when running multi-host. Idempotent: a second fit() in an
+    Returns True when running multi-host. A second fit() in an
     already-initialized process (e.g. back-to-back workloads in one
-    worker) keeps the existing rendezvous instead of raising.
+    worker) reuses the live rendezvous ONLY when the requested topology
+    matches it (num_processes/process_id); a mismatch raises ValueError —
+    silently reusing a different topology would be a bug, not a
+    reconnect. A differing coordinator string merely warns (jax may
+    normalize the address, and it is only readable from private state).
     """
     if coordinator_address is None:
         return False

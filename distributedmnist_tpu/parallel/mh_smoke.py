@@ -1,0 +1,75 @@
+"""Multi-process smoke worker for the driver's dryrun gate
+(`__graft_entry__.dryrun_multichip`, leg `dp:2proc`).
+
+BASELINE.json config 5 is multi-HOST; a single-process mesh — however many
+virtual devices it has — never exercises the `jax.distributed` rendezvous,
+the cross-process psum, or orbax's cross-process save coordination. This
+worker is one process of an N-process localhost run: it joins the
+rendezvous, owns `--devices-per-proc` virtual CPU devices of the global
+mesh, runs a short data-parallel fit (with checkpoint save/restore when
+`--ckpt-dir` is given), and prints one `MHSMOKE {json}` line the gate
+asserts on. Run as `python -m distributedmnist_tpu.parallel.mh_smoke`.
+
+Kept deliberately self-contained (argparse + env setup + one fit) so the
+driver gate has no dependency on the test tree; the richer assertions
+(gather locality, preemption agreement) live in tests/multihost_worker.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("process_id", type=int)
+    p.add_argument("num_processes", type=int)
+    p.add_argument("port")
+    p.add_argument("--devices-per-proc", type=int, default=4)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--steps", type=int, default=6)
+    args = p.parse_args()
+
+    # Env must be fixed BEFORE jax's first backend init: CPU-only (no TPU
+    # relay dial from gate workers) and exactly devices-per-proc virtual
+    # devices, replacing any count inherited from the parent.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags
+        + f" --xla_force_host_platform_device_count={args.devices_per_proc}")
+
+    from distributedmnist_tpu import trainer
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.data import synthetic_mnist
+
+    data = synthetic_mnist(seed=3, train_n=1024, test_n=256)
+    cfg = Config(model="mlp", optimizer="sgd", learning_rate=0.05,
+                 device="cpu", synthetic=True, batch_size=64,
+                 steps=args.steps, eval_every=args.steps, log_every=0,
+                 target_accuracy=None,
+                 coordinator_address=f"localhost:{args.port}",
+                 num_processes=args.num_processes,
+                 process_id=args.process_id,
+                 checkpoint_dir=args.ckpt_dir,
+                 checkpoint_every=max(1, args.steps // 2))
+    out = trainer.fit(cfg, data=data)
+    print("MHSMOKE " + json.dumps({
+        "process_id": args.process_id,
+        "multihost": out["multihost"],
+        "n_processes": out["n_processes"],
+        "n_chips": out["n_chips"],
+        "steps": out["steps"],
+        "restored": out["restored"],
+        "accuracy": out["test_accuracy"],
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

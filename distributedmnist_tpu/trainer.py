@@ -357,8 +357,17 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     steps_per_epoch = ds.train_n // cfg.batch_size
     total_steps = cfg.steps if cfg.steps is not None \
         else cfg.epochs * steps_per_epoch
+    # Decay horizon: the run's own length unless pinned — lr_decay_steps
+    # keeps a tuned cosine recipe's curve invariant to the budget knobs
+    # (--max-epochs/--steps), which otherwise silently reshape it.
+    if cfg.lr_decay_steps is not None and cfg.lr_decay_steps < 1:
+        raise ValueError(
+            f"lr_decay_steps must be >= 1, got {cfg.lr_decay_steps} "
+            "(omit it to decay over the run's own length)")
     lr = optim.make_schedule(cfg.learning_rate, cfg.lr_schedule,
-                             cfg.warmup_steps, total_steps)
+                             cfg.warmup_steps,
+                             total_steps if cfg.lr_decay_steps is None
+                             else cfg.lr_decay_steps)
     # TP shards optimizer moments by leaf name (parallel/tp.py); the flat
     # update's single-vector state can't be, so TP forces per-leaf.
     tx = optim.build(cfg.optimizer, lr, cfg.momentum,
@@ -440,12 +449,15 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
             StepTimer.barrier(inflight[-1])
             inflight.clear()
 
+    n_evals = [0]
+
     def evaluate(state) -> float:
         # Inside timer.exclude(): eval seconds must not deflate the
         # training-throughput metric (the BASELINE headline number) —
         # but the queued TRAIN blocks ahead of it must finish on the
         # counted clock first.
         drain_inflight()
+        n_evals[0] += 1
         with timer.exclude():
             correct = eval_fn(state.params, ds.test_x, ds.test_y,
                               idx_mat, mask_mat)
@@ -667,6 +679,7 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         "data_pipeline": cfg.data_pipeline,
         "pixel_format": pixel_format,
         "steps": int(state.step),
+        "n_evals": n_evals[0],
         "restored": restored,
         "preempted": preempted,
         "test_accuracy": accuracy,

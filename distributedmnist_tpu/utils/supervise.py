@@ -41,9 +41,12 @@ def run_supervised(script: str, argv: list[str],
                    fallback_env: Optional[dict] = None) -> int:
     """Run `python -u script *argv` as a worker (marked via env); kill +
     retry if it produces no output for stall_timeout seconds. `accept`
-    maps the worker's stdout lines to the result to forward (or None if
-    the output contains no valid result). Returns the exit code; the
-    accepted result is written to stdout. Never imports jax.
+    maps worker stdout lines to the result to forward (or None if they
+    contain no valid result); it is called with successive chunks of
+    NEWLY-arrived lines — not the whole buffer — and the most recent
+    non-None result wins, so each line is scanned once per attempt.
+    Returns the exit code; the accepted result is written to stdout.
+    Never imports jax.
 
     If every attempt fails and `fallback_env` is given, ONE extra attempt
     runs with those env overrides (a None value UNSETS the variable) —
@@ -83,6 +86,23 @@ def run_supervised(script: str, argv: list[str],
         for t in threads:
             t.start()
 
+        # Incremental result scan: each one-second poll hands accept()
+        # only the lines that arrived since the last poll and caches the
+        # latest hit — re-scanning the whole buffer every poll is
+        # O(lines^2) over a chatty multi-hour run (round-4 advice).
+        scanned = 0
+        cached = None
+
+        def current_result():
+            nonlocal scanned, cached
+            new = out_lines[scanned:]
+            scanned += len(new)
+            if new:
+                r = accept(new)
+                if r is not None:
+                    cached = r
+            return cached
+
         stalled = False
         teardown_grace = min(30.0, stall_timeout)
         # Hard per-attempt ceiling: a wedged worker that emits periodic
@@ -96,7 +116,7 @@ def run_supervised(script: str, argv: list[str],
         deadline = time.monotonic() + max(8 * stall_timeout, 2400.0)
         while proc.poll() is None:
             quiet = time.monotonic() - last[0]
-            if accept(out_lines) is not None and quiet > teardown_grace:
+            if current_result() is not None and quiet > teardown_grace:
                 # Result produced; only runtime teardown is hanging
                 # (pooled-backend clients can wedge at exit too).
                 break
@@ -119,7 +139,7 @@ def run_supervised(script: str, argv: list[str],
         for t in threads:
             t.join(timeout=5)
 
-        result = accept(out_lines)
+        result = current_result()
         if result is not None:
             sys.stdout.write(result)
             sys.stdout.flush()

@@ -21,21 +21,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn(n, port, extra=()):
+def _spawn(n, port, extra=(), cmd=None):
     env, repo_root = worker_env()
-    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    if cmd is None:
+        cmd = [sys.executable,
+               os.path.join(os.path.dirname(__file__),
+                            "multihost_worker.py")]
     return [
         subprocess.Popen(
-            [sys.executable, worker, str(i), str(n), str(port),
-             *map(str, extra)],
+            cmd + [str(i), str(n), str(port), *map(str, extra)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env, cwd=repo_root)
         for i in range(n)
     ]
 
 
-def _launch(n, port, extra=()):
-    procs = _spawn(n, port, extra)
+def _launch(n, port, extra=(), cmd=None):
+    procs = _spawn(n, port, extra, cmd)
     outs = []
     try:
         for p in procs:
@@ -132,6 +134,38 @@ def test_two_process_sigterm_preemption(tmp_path):
         assert r["restored"] is True
         assert r["preempted"] is False
         assert r["steps"] == saved_step + 6
+
+
+@pytest.mark.slow
+def test_mh_smoke_gate_worker(tmp_path):
+    """The driver gate's dp:2proc worker (parallel/mh_smoke.py, spawned by
+    __graft_entry__.dryrun_multichip) runs the same rendezvous/psum/
+    checkpoint path as the suite's own worker — exercised here so the gate
+    leg can't bit-rot between driver runs. Mirrors the gate's two-pair
+    sequence: fresh run with a coordinated save, then a fresh pair
+    restoring it."""
+    ckpt = str(tmp_path / "gate-ckpt")
+
+    def run_pair(steps, port):
+        outs = _launch(
+            2, port,
+            extra=("--devices-per-proc", "4", "--ckpt-dir", ckpt,
+                   "--steps", steps),
+            cmd=[sys.executable, "-m",
+                 "distributedmnist_tpu.parallel.mh_smoke"])
+        return [json.loads(r) for r in _results(outs, tag="MHSMOKE ")]
+
+    r1 = run_pair(6, _free_port())
+    for r in r1:
+        assert r["multihost"] is True and r["n_processes"] == 2
+        assert r["n_chips"] == 8 and r["steps"] == 6
+        assert r["restored"] is False
+    assert r1[0]["accuracy"] == r1[1]["accuracy"]
+
+    r2 = run_pair(9, _free_port())
+    for r in r2:
+        assert r["restored"] is True and r["steps"] == 9
+    assert r2[0]["accuracy"] == r2[1]["accuracy"]
 
 
 @pytest.mark.slow

@@ -20,6 +20,42 @@ from distributedmnist_tpu.data import synthetic_mnist
 
 
 @pytest.mark.slow
+def test_cli_redelivers_sigterm_after_summary(tmp_path):
+    """train.py (the CLI boundary) re-delivers an absorbed SIGTERM after
+    printing the summary line (round-4 advice): external orchestrators
+    see conventional process semantics — exit status terminated-by-SIGTERM
+    — while the checkpoint is saved and the summary still emitted."""
+    ckpt = str(tmp_path / "cli-pre")
+    env, repo_root = worker_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(repo_root, "train.py"),
+         "--device", "cpu", "--num-devices", "8", "--synthetic",
+         "--model", "mlp", "--optimizer", "sgd", "--learning-rate", "0.05",
+         "--batch-size", "64", "--steps", "200000",
+         "--eval-every", "1000000", "--log-every", "0",
+         "--checkpoint-dir", ckpt, "--checkpoint-every", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=repo_root)
+    try:
+        wait_for_committed_checkpoint(ckpt, [p])
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=300)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    # conventional semantics: the process dies BY the signal...
+    assert p.returncode == -signal.SIGTERM, (p.returncode, out[-3000:])
+    # ...but only after the summary line (with the preempted flag) and
+    # the force-save made it out
+    lines = [l for l in out.splitlines() if l.startswith("{")]
+    assert lines, f"no summary line:\n{out[-3000:]}"
+    summary = json.loads(lines[-1])
+    assert summary["preempted"] is True
+    assert summary["steps"] in committed_steps(ckpt)
+
+
+@pytest.mark.slow
 def test_sigterm_saves_and_resumes(tmp_path):
     ckpt = str(tmp_path / "pre")
     env, repo_root = worker_env()

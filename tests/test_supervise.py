@@ -106,6 +106,36 @@ print(json.dumps({"metric": "m", "value": 9}))
     assert "fallback attempt" in cap.err
 
 
+def test_accept_scans_each_line_once(tmp_path, capfd):
+    """run_supervised hands accept() only NEWLY-arrived lines per poll
+    (round-4 advice: the old full-buffer rescan was O(lines^2) over a
+    chatty multi-hour run) — and the cached result still forwards."""
+    script = _write(tmp_path, """
+import json, time
+for i in range(40):
+    print(f"chatter {i}")
+    time.sleep(0.05)                # spread output across several polls
+print(json.dumps({"metric": "m", "value": 3}))
+time.sleep(3)                       # keep polling after the result
+""")
+    calls = []
+    inner = _accept()
+
+    def spy(lines):
+        calls.append(len(lines))
+        return inner(lines)
+
+    rc = supervise.run_supervised(script, [], spy,
+                                  stall_timeout=30, attempts=1)
+    assert rc == 0
+    out = capfd.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1])["value"] == 3
+    # every line is scanned exactly once: chunk sizes sum to the 41
+    # lines printed, across more than one poll
+    assert sum(calls) == 41
+    assert len(calls) > 1
+
+
 def test_acceptor_ignores_non_record_json():
     accept = _accept()
     assert accept(["[1, 2]\n", "42\n", '"metric"\n']) is None

@@ -58,6 +58,18 @@ def main(argv=None) -> int:
     from distributedmnist_tpu import trainer  # after flags: jax import cost
     summary = trainer.fit(cfg)
     print(trainer.MetricsLogger.summary_line(summary))
+    if summary.get("preempted"):
+        # fit() absorbed a SIGTERM to force-save the checkpoint and
+        # reports it in the summary; at the CLI boundary the signal is
+        # RE-DELIVERED after the summary line so process-level semantics
+        # stay conventional for external orchestrators (exit status reads
+        # terminated-by-SIGTERM, and nothing after fit() keeps running
+        # when the scheduler asked us to stop). fit() restored the
+        # default disposition before returning, so this terminates.
+        import os
+        import signal
+        sys.stdout.flush()
+        os.kill(os.getpid(), signal.SIGTERM)
     return 0
 
 
