@@ -267,12 +267,25 @@ def make_eval_fn(model, mesh, dtype=jnp.float32):
     return jax.jit(_eval)
 
 
-def _pick_steps_per_call(cfg: Config, platform: str, has_ckpt: bool) -> int:
+def _pick_steps_per_call(cfg: Config, platform: str, has_ckpt: bool,
+                         streaming: bool = False) -> int:
     """Steps fused per XLA dispatch. Auto: 1 on CPU (synchronous, small
-    thread pool); on TPU the largest k <= 256 dividing the eval/checkpoint
-    cadence, so block edges land exactly on eval and checkpoint steps.
-    (lax.scan compiles its body once, so compile time is k-independent;
-    measured throughput plateaus around k=256 on the v5e here.)"""
+    thread pool); on TPU the largest k <= 1024 dividing the eval/
+    checkpoint cadence, so block edges land exactly on eval and
+    checkpoint steps. (lax.scan compiles its body once, so compile time
+    is k-independent. The ceiling was 256 through round 4; same-window
+    bench measurements found throughput still rising to k~1024 at b=512
+    — a 256-step block's ~125 ms of device time sits right at one relay
+    RTT, so per-block fetch costs leak in below that. The cadence
+    divisor rule still binds first for typical eval_every values.)
+
+    The STREAMING pipeline keeps the 256 ceiling: each of its dispatched
+    blocks materializes a full (k, B, ...) input array on device and the
+    bounded in-flight window keeps up to max_inflight of them live —
+    quadrupling k quadruples queued-input HBM on exactly the pipeline
+    that exists for datasets too big to sit in HBM. The device-resident
+    pipeline's blocks carry only (k, B) int32 indices, where deep is
+    free."""
     if cfg.steps_per_call is not None:
         return max(1, cfg.steps_per_call)
     if platform == "cpu":
@@ -283,7 +296,7 @@ def _pick_steps_per_call(cfg: Config, platform: str, has_ckpt: bool) -> int:
         cadence = math.gcd(cadence, cfg.checkpoint_every)
     if cfg.fail_at_step:
         cadence = math.gcd(cadence, cfg.fail_at_step)
-    for k in range(min(256, cadence), 0, -1):
+    for k in range(min(256 if streaming else 1024, cadence), 0, -1):
         if cadence % k == 0:
             return k
     return 1
@@ -487,7 +500,8 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         jax.profiler.start_trace(cfg.profile_dir)
         profiling = True
 
-    spc = _pick_steps_per_call(cfg, devices[0].platform, bool(ckpt))
+    spc = _pick_steps_per_call(cfg, devices[0].platform, bool(ckpt),
+                               streaming=streaming)
 
     def crossed(step_before: int, step_after: int, every: int) -> bool:
         return step_after // every > step_before // every

@@ -74,7 +74,7 @@ def test_fit_steps_per_call_matches_default(tiny_data):
 def test_pick_steps_per_call():
     cfg = Config(eval_every=200, checkpoint_every=500)
     assert trainer._pick_steps_per_call(cfg, "cpu", False) == 1
-    # tpu: largest k <= 256 dividing eval_every
+    # tpu: largest k <= 1024 dividing eval_every
     assert trainer._pick_steps_per_call(cfg, "tpu", False) == 200
     # with checkpointing: divides gcd(200, 500) = 100
     assert trainer._pick_steps_per_call(cfg, "tpu", True) == 100
@@ -82,3 +82,13 @@ def test_pick_steps_per_call():
         cfg.replace(steps_per_call=7), "tpu", True) == 7
     assert trainer._pick_steps_per_call(
         cfg.replace(eval_every=3), "tpu", False) == 3
+    # the ceiling binds only above 1024 (raised from 256 in round 5:
+    # 256-step blocks sit at one relay RTT of device time at b=512)
+    assert trainer._pick_steps_per_call(
+        cfg.replace(eval_every=2048), "tpu", False) == 1024
+    assert trainer._pick_steps_per_call(
+        cfg.replace(eval_every=1000), "tpu", False) == 1000
+    # streaming keeps the 256 ceiling: its blocks materialize full
+    # (k, B, ...) input arrays, and the in-flight window holds up to 16
+    assert trainer._pick_steps_per_call(
+        cfg.replace(eval_every=2048), "tpu", False, streaming=True) == 256
