@@ -5,18 +5,30 @@ Isolates each component of the hot-loop step (scan floor, batch gather
 variants, forward, backward, optimizer variants, gather/compute
 double-buffering) as its OWN scanned+jitted program and times each with
 the honest fetch barrier (StepTimer.barrier — block_until_ready lies on
-this host's relay backend). Prints one JSON line per variant plus a
-summary table on stderr.
+this host's relay backend). Prints ONE JSON record (ms/iter keyed by
+variant) on stdout plus a summary table on stderr.
 
-Usage: timeout 900 python scripts/profile_step.py [--batch 512] [--k 256]
+Runs in a stall-supervised worker subprocess like bench.py (the relay's
+claim leg can wedge a fresh process forever; the supervisor kills and
+retries on silence) — --inline bypasses supervision.
+
+Usage: python scripts/profile_step.py [--batch 512] [--k 256]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# Runnable as `python scripts/profile_step.py` from anywhere: python puts
+# scripts/ (not the repo root) on sys.path for a script invocation, so
+# the package import below would otherwise need PYTHONPATH set by hand.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def mark(msg):
@@ -31,7 +43,21 @@ def main():
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--only", default=None,
                    help="comma-separated variant names to run")
+    p.add_argument("--stall-timeout", type=float, default=300.0,
+                   help="kill+retry the worker if it is silent this long")
+    p.add_argument("--max-attempts", type=int, default=3,
+                   help="worker attempts before giving up")
+    p.add_argument("--inline", action="store_true",
+                   help="run in-process (no supervisor subprocess)")
     args = p.parse_args()
+
+    from distributedmnist_tpu.utils import supervise
+
+    if not args.inline and not supervise.is_worker():
+        return supervise.run_supervised(
+            os.path.abspath(__file__), list(sys.argv[1:]),
+            accept=supervise.json_record_acceptor("ms_per_iter"),
+            stall_timeout=args.stall_timeout, attempts=args.max_attempts)
 
     import jax
     import jax.numpy as jnp
@@ -186,6 +212,11 @@ def main():
         StepTimer.barrier(sync_of(carry, out))
         times = []
         for r in range(args.repeats):
+            # Liveness for the supervisor: at large --batch/--k/--blocks
+            # one repeat's barrier wait is long, and silence past the
+            # stall timeout would kill a healthy worker (the stderr
+            # print costs microseconds against a multi-second repeat).
+            mark(f"{name}: repeat {r + 1}/{args.repeats}")
             t0 = time.perf_counter()
             for _ in range(args.blocks):
                 carry, out = fn(carry, idx)

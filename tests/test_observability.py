@@ -251,6 +251,32 @@ def test_bench_smoke_contract():
 
 
 @pytest.mark.slow
+def test_profile_step_contract(tmp_path):
+    """scripts/profile_step.py: supervised, runnable from any cwd (it
+    bootstraps the repo root onto sys.path itself), one JSON record with
+    the requested variants. Guards the per-slice profiling tool the
+    BASELINE.md step-anatomy claims are built from."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PYTHONPATH", None)   # the script must self-bootstrap
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "profile_step.py"),
+         "--only", "empty", "--blocks", "1", "--repeats", "1",
+         "--k", "4", "--batch", "8"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec["ms_per_iter"]) == {"empty"}
+    assert rec["ms_per_iter"]["empty"] > 0
+
+
+@pytest.mark.slow
 def test_bench_smoke_real_data_dir(tmp_path):
     """--data-dir plumbed through bench (not just trainer.fit): smoke
     loads REAL-format IDX fixtures and must label the run data=real."""
