@@ -19,6 +19,15 @@ two halves of the BASELINE metric ("MNIST images/sec/chip; wall-clock to
   few scanned blocks, eval, checkpoint save, then restore+resume in the
   same process; JSON verdict. Cheap enough to run every round; catches
   TPU-path regressions the CPU test suite can't.
+- serve (also: `python bench.py serve`): load harness for the batched
+  inference engine (distributedmnist_tpu/serve/). A closed-loop phase
+  (--serve-clients back-to-back clients) measures serving capacity in
+  images/sec/chip — the headline value — then an open-loop phase replays
+  Poisson arrivals at each --serve-qps target, yielding the
+  latency-vs-throughput table (p50/p95/p99 per point) plus
+  batch-occupancy and backpressure-rejection counts. The engine warms
+  its compile buckets first; steady state is asserted recompile-free
+  (detail.recompiles_after_warmup).
 
 The measurement runs in a supervised worker subprocess: TPU runtime claims
 through tunneled/pooled backends can wedge forever before the first
@@ -86,10 +95,12 @@ def _barrier_marked(sync, every: float = 15.0) -> None:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--mode",
-                   choices=["throughput", "time-to-accuracy", "sweep",
-                            "smoke"],
-                   default="throughput")
+    modes = ["throughput", "time-to-accuracy", "sweep", "smoke", "serve"]
+    p.add_argument("mode_pos", nargs="?", choices=modes, default=None,
+                   metavar="mode",
+                   help="positional alias for --mode "
+                        "(e.g. `python bench.py serve`)")
+    p.add_argument("--mode", choices=modes, default=None)
     p.add_argument("--target-accuracy", type=float, default=0.99)
     p.add_argument("--data-dir", default=None,
                    help="real MNIST IDX/npz dir; synthetic fallback")
@@ -124,11 +135,83 @@ def main(argv=None) -> int:
                    help="worker attempts before giving up")
     p.add_argument("--inline", action="store_true",
                    help="run in-process (no supervisor subprocess)")
+    p.add_argument("--serve-qps", default=None,
+                   help="[serve] comma-separated open-loop Poisson QPS "
+                        "targets (default: 50,200 on cpu; "
+                        "1000,4000,16000 on tpu)")
+    p.add_argument("--serve-duration", type=float, default=None,
+                   help="[serve] seconds per load phase "
+                        "(default: 2 on cpu, 10 on tpu)")
+    p.add_argument("--serve-clients", type=int, default=None,
+                   help="[serve] closed-loop concurrent clients "
+                        "(default: 8 on cpu, 64 on tpu)")
+    p.add_argument("--serve-rows", type=int, default=1,
+                   help="[serve] images per request (default 1)")
+    p.add_argument("--serve-max-batch", type=int, default=None,
+                   help="[serve] rows per dispatch cap / top compile "
+                        "bucket (default: 128 on cpu, 512 on tpu)")
+    p.add_argument("--serve-max-wait-us", type=int, default=None,
+                   help="[serve] batch-coalescing wait bound "
+                        "(default 1000)")
+    p.add_argument("--serve-queue-depth", type=int, default=None,
+                   help="[serve] backpressure watermark in pending rows "
+                        "(default 4096)")
     args = p.parse_args(argv)
 
     # Cheap arg-only validation FIRST: a deterministic usage error must
     # exit 2 immediately, not be retried in supervised subprocesses.
-    if args.mode in ("throughput", "sweep"):
+    if args.mode_pos is not None:
+        if args.mode is not None and args.mode != args.mode_pos:
+            p.error(f"positional mode {args.mode_pos!r} contradicts "
+                    f"--mode {args.mode!r}")
+        args.mode = args.mode_pos
+    if args.mode is None:
+        args.mode = "throughput"
+    serve_flags = {"--serve-qps": args.serve_qps,
+                   "--serve-duration": args.serve_duration,
+                   "--serve-clients": args.serve_clients,
+                   "--serve-max-batch": args.serve_max_batch,
+                   "--serve-max-wait-us": args.serve_max_wait_us,
+                   "--serve-queue-depth": args.serve_queue_depth}
+    if args.mode != "serve":
+        given = [k for k, v in serve_flags.items() if v is not None]
+        if given or args.serve_rows != 1:
+            p.error(f"{', '.join(given) or '--serve-rows'} are serve-"
+                    "mode flags; rejected rather than silently ignored")
+    if args.mode == "serve":
+        # Training measurement knobs are meaningless against the serving
+        # engine; reject them (the repo-wide principle).
+        if (args.warmup_steps is not None or args.bench_steps is not None
+                or args.repeats is not None or args.trials is not None
+                or args.steps_per_call is not None
+                or args.global_batch is not None
+                or args.data_dir is not None):
+            p.error("serve mode takes --model/--dtype and the --serve-* "
+                    "flags; training measurement flags belong to the "
+                    "other modes")
+        if args.serve_rows < 1:
+            p.error("--serve-rows must be >= 1")
+        if args.serve_max_batch is not None and args.serve_max_batch < 1:
+            p.error("--serve-max-batch must be >= 1")
+        if (args.serve_max_wait_us is not None
+                and args.serve_max_wait_us < 0):
+            p.error("--serve-max-wait-us must be >= 0 "
+                    "(0 = no coalescing wait)")
+        if args.serve_queue_depth is not None and args.serve_queue_depth < 1:
+            p.error("--serve-queue-depth must be >= 1")
+        if args.serve_duration is not None and args.serve_duration <= 0:
+            p.error("--serve-duration must be > 0")
+        if args.serve_clients is not None and args.serve_clients < 1:
+            p.error("--serve-clients must be >= 1")
+        if args.serve_qps is not None:
+            try:
+                args.serve_qps = sorted(
+                    {float(q) for q in args.serve_qps.split(",")})
+            except ValueError:
+                p.error("--serve-qps must be comma-separated numbers")
+            if not args.serve_qps or args.serve_qps[0] <= 0:
+                p.error("--serve-qps targets must be positive")
+    elif args.mode in ("throughput", "sweep"):
         if args.trials is not None:
             p.error("--trials is a time-to-accuracy flag; throughput/"
                     "sweep take --repeats")
@@ -196,6 +279,8 @@ def main(argv=None) -> int:
         return _smoke(args)
     if args.mode == "sweep":
         return _sweep(args)
+    if args.mode == "serve":
+        return _serve(args)
     return _throughput(args)
 
 
@@ -609,6 +694,175 @@ def _smoke(args) -> int:
                 round(out1["images_per_sec_per_chip"], 1),
             "short_window": True,
             "window_steps": 64,
+        },
+    }))
+    return 0
+
+
+def _serve(args) -> int:
+    """Serving load harness: closed-loop capacity (the headline
+    images/sec/chip) plus an open-loop Poisson QPS sweep giving the
+    latency-vs-throughput table. Same perf discipline as the training
+    bench: bucket warmup (compile) excluded from every window, per-chip
+    normalization, and a recompile counter proving steady state ran
+    shape-stable."""
+    import random
+    import threading
+
+    import numpy as np
+
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.serve import (DynamicBatcher, Rejected,
+                                            ServeMetrics, build_engine)
+
+    cfg = Config(model=args.model, dtype=args.dtype)
+    # Resolve backend-dependent defaults AFTER the engine is up (the
+    # same pattern as bench_steps): CPU phases are kept short — each
+    # sweep point costs its full wall-clock duration.
+    engine = build_engine(cfg.replace(
+        serve_max_batch=(cfg.serve_max_batch
+                         if args.serve_max_batch is None
+                         else args.serve_max_batch)))
+    backend = engine.mesh.devices.flat[0].platform
+    on_cpu = backend == "cpu"
+    _mark(f"backend up: {engine.n_chips}x {backend}")
+    if args.serve_max_batch is None and on_cpu:
+        # rebuild with the CPU-sized bucket ladder (cheap: CPU compiles
+        # are fast and the persistent cache absorbs repeats)
+        engine = build_engine(cfg.replace(serve_max_batch=128))
+    # `is None` checks, not `or`: an explicit 0 (e.g. --serve-max-wait-us
+    # 0 to measure the no-coalescing latency floor) must be honored.
+    max_wait_us = (cfg.serve_max_wait_us if args.serve_max_wait_us is None
+                   else args.serve_max_wait_us)
+    queue_depth = (cfg.serve_queue_depth if args.serve_queue_depth is None
+                   else args.serve_queue_depth)
+    duration = ((2.0 if on_cpu else 10.0) if args.serve_duration is None
+                else args.serve_duration)
+    clients = ((8 if on_cpu else 64) if args.serve_clients is None
+               else args.serve_clients)
+    qps_sweep = (([50.0, 200.0] if on_cpu
+                  else [1000.0, 4000.0, 16000.0])
+                 if args.serve_qps is None else args.serve_qps)
+    rows = args.serve_rows
+
+    _mark(f"warming {len(engine.buckets)} buckets {list(engine.buckets)}")
+    warm_compiles = engine.warmup()
+    steady_from = engine.compile_events()
+
+    metrics = ServeMetrics()
+    batcher = DynamicBatcher(engine, max_batch=engine.max_batch,
+                             max_wait_us=max_wait_us,
+                             queue_depth=queue_depth,
+                             metrics=metrics).start()
+    rng = np.random.default_rng(0)
+    req = rng.integers(0, 256, (rows, 28, 28, 1), dtype=np.uint8)
+
+    # Closed loop: each client waits for its result before the next
+    # submit, so concurrency == clients and the batcher coalesces to its
+    # natural occupancy — serving capacity, not queue-melt throughput.
+    client_errors: list = []
+
+    def client(stop_at: float):
+        while time.monotonic() < stop_at:
+            try:
+                batcher.submit(req).result(timeout=120)
+            except Rejected:
+                time.sleep(0.001)   # shed: brief client backoff
+            except BaseException as e:
+                # A dead client thread deflates the capacity headline
+                # silently; record and fail the bench after join.
+                client_errors.append(e)
+                return
+
+    _mark(f"closed loop: {clients} clients x {duration:.0f}s")
+    metrics.reset()
+    stop_at = time.monotonic() + duration
+    threads = [threading.Thread(target=client, args=(stop_at,),
+                                daemon=True) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if client_errors:
+        raise RuntimeError(
+            f"{len(client_errors)} of {clients} closed-loop clients "
+            "died; the capacity headline would be measured against a "
+            "degraded pool") from client_errors[0]
+    closed = metrics.snapshot()
+    value = closed["rows_per_sec"] / engine.n_chips
+    _mark(f"closed loop: {value:.0f} img/s/chip "
+          f"(p99 {closed['latency_ms']['p99']} ms)")
+
+    # Open loop: Poisson arrivals at each target QPS. Submissions don't
+    # wait for results (metrics record latency at completion), so queue
+    # growth and backpressure rejections are visible exactly when the
+    # target exceeds capacity.
+    table = []
+    arrivals = random.Random(0)
+    for qps in qps_sweep:
+        metrics.reset()
+        t_end = time.monotonic() + duration
+        next_t = time.monotonic()
+        submitted = 0
+        while next_t < t_end:
+            now = time.monotonic()
+            if next_t > now:
+                time.sleep(next_t - now)
+            try:
+                batcher.submit(req)
+                submitted += 1
+            except Rejected:
+                pass                # recorded by metrics
+            next_t += arrivals.expovariate(qps)
+        while batcher.pending_rows():
+            time.sleep(0.005)
+        time.sleep(max_wait_us / 1e6 + 0.05)   # let the last batch land
+        snap = metrics.snapshot()
+        table.append({
+            "qps_target": qps,
+            "qps_submitted": round(submitted / duration, 1),
+            "requests_per_sec": snap["requests_per_sec"],
+            "img_s_chip": round(snap["rows_per_sec"] / engine.n_chips,
+                                1),
+            "latency_ms": snap["latency_ms"],
+            "mean_rows_per_batch": snap["mean_rows_per_batch"],
+            "batch_occupancy": snap["batch_occupancy"],
+            "rejected_requests": snap["rejected_requests"],
+        })
+        _mark(f"open loop qps={qps:g}: p50="
+              f"{snap['latency_ms']['p50']} ms, "
+              f"{snap['rejected_requests']} rejected")
+    batcher.stop()
+
+    recompiles = engine.compile_events() - steady_from
+    if recompiles:
+        _mark(f"WARNING: {recompiles} compile events after warmup — "
+              "steady state was supposed to be shape-stable")
+    print(json.dumps({
+        "metric": "serve_images_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "images/sec/chip",
+        # Serving shares the training north-star rate target: a system
+        # meeting 2,500 img/s/chip in training should serve at least as
+        # fast forward-only.
+        "vs_baseline": round(value / TARGET_IPS_PER_CHIP, 3),
+        "detail": {
+            "model": args.model,
+            "dtype": args.dtype,
+            "backend": backend,
+            "n_chips": engine.n_chips,
+            "buckets": list(engine.buckets),
+            "max_batch": engine.max_batch,
+            "max_wait_us": max_wait_us,
+            "queue_depth": queue_depth,
+            "rows_per_request": rows,
+            "clients": clients,
+            "duration_s": duration,
+            "params": "fresh-init",
+            "warmup_compile_events": warm_compiles,
+            "recompiles_after_warmup": recompiles,
+            "closed_loop": closed,
+            "qps_sweep": table,
         },
     }))
     return 0

@@ -70,11 +70,14 @@ class Checkpointer:
         try:
             restored = self.mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
-        except (ValueError, TypeError, KeyError) as e:
+        except (ValueError, TypeError, KeyError, AssertionError) as e:
             # Structure mismatches surface as ValueError/TypeError/
-            # KeyError from orbax's tree handling (IO failures — a
-            # half-written directory, permissions — raise OSError and
-            # pass through untouched). The most common cause: the
+            # KeyError from orbax's tree handling — and, on some orbax
+            # versions, as AssertionError ("Expected RestoreArgs or
+            # SaveArgs") when the saved tree and the abstract target
+            # disagree leaf-for-leaf (IO failures — a half-written
+            # directory, permissions — raise OSError and pass through
+            # untouched). The most common cause: the
             # checkpoint was written with the other optimizer-state
             # layout; try the exact flat<->per-leaf conversion before
             # giving up, and surface the knob instead of an opaque
@@ -207,10 +210,22 @@ class Checkpointer:
             # same flatten position — the two trees may flatten in
             # different orders; the fingerprint match above guarantees
             # the path sets coincide).
+            saved_flat = jax.tree_util.tree_flatten_with_path(
+                saved_opt)[0]
             saved_dtypes = {
                 _path_of(path): np.dtype(leaf.dtype)
-                for path, leaf
-                in jax.tree_util.tree_flatten_with_path(saved_opt)[0]}
+                for path, leaf in saved_flat}
+            # Normalized key paths must be unique: _key_str's str(k)
+            # fallback makes collisions possible for exotic key types,
+            # and a collision would silently overwrite one leaf's dtype
+            # with another's — the restore then picks a wrong dtype and
+            # fails structurally without saying why (ADVICE r5). Fail
+            # loudly at the source instead.
+            assert len(saved_dtypes) == len(saved_flat), (
+                "normalized opt_state key paths collide "
+                f"({len(saved_flat)} leaves -> {len(saved_dtypes)} "
+                "distinct paths); _key_str cannot disambiguate this "
+                "checkpoint's tree")
             src_flat, src_def = jax.tree_util.tree_flatten_with_path(
                 src_opt)
             src_opt = jax.tree.unflatten(src_def, [
@@ -222,7 +237,10 @@ class Checkpointer:
         try:
             src = self.mgr.restore(
                 step, args=ocp.args.StandardRestore(src_abstract))
-        except (ValueError, TypeError, KeyError):
+        except (ValueError, TypeError, KeyError, AssertionError):
+            # Same exception surface as the first restore attempt; the
+            # collision assert above raises BEFORE this try, so it
+            # cannot be swallowed here.
             return None
 
         if target_flat:

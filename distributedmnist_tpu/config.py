@@ -101,6 +101,15 @@ class Config:
     fused_kernels: str = "auto"     # {auto, pallas, xla}: pallas fused MLP layer
     conv_impl: str = "auto"         # {auto, im2col, lax}: LeNet conv path
                                     # (auto: patch-matmul on TPU, lax on CPU)
+    # serving (serve/, serve.py, bench.py serve): the dynamic batcher's
+    # latency/throughput knobs. max_batch bounds rows per dispatch (and
+    # the engine's top compile bucket); max_wait_us bounds how long the
+    # oldest queued request may wait for coalescing; queue_depth is the
+    # backpressure watermark in pending rows — beyond it submissions are
+    # rejected with 503 semantics instead of melting latency.
+    serve_max_batch: int = 512
+    serve_max_wait_us: int = 1000
+    serve_queue_depth: int = 4096
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -194,6 +203,15 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    default=None)
     p.add_argument("--grad-accum", type=int, default=None,
                    help="microbatches accumulated per optimizer step")
+    p.add_argument("--serve-max-batch", type=int, default=None,
+                   help="[serving] max rows per inference dispatch (also "
+                        "the engine's top compile bucket)")
+    p.add_argument("--serve-max-wait-us", type=int, default=None,
+                   help="[serving] max microseconds the oldest queued "
+                        "request waits for batch coalescing")
+    p.add_argument("--serve-queue-depth", type=int, default=None,
+                   help="[serving] backpressure watermark in pending "
+                        "rows; beyond it requests are rejected (503)")
     p.add_argument("--no-flat-optimizer", dest="flat_optimizer",
                    action="store_false", default=None,
                    help="per-leaf optimizer update instead of the fused "

@@ -26,6 +26,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 
+def _is_initialized() -> bool:
+    """jax.distributed.is_initialized, tolerating jax versions that
+    predate the public accessor (e.g. 0.4.37): fall back to the private
+    global_state's live client, defaulting to 'not initialized' if that
+    moves too — initialize() would then raise on a genuine double-init,
+    which is still a clear error rather than silent reuse."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except ImportError:
+        return False
+
+
 def maybe_initialize(coordinator_address: Optional[str],
                      num_processes: Optional[int],
                      process_id: Optional[int]) -> bool:
@@ -41,7 +57,21 @@ def maybe_initialize(coordinator_address: Optional[str],
     """
     if coordinator_address is None:
         return False
-    if not jax.distributed.is_initialized():
+    if not _is_initialized():
+        # Multi-process runs on the CPU backend (the localhost test/gate
+        # topology) need an explicit cross-process collectives
+        # implementation on jax versions whose default CPU client is
+        # single-process-only ("Multiprocess computations aren't
+        # implemented on the CPU backend"). The option only affects CPU
+        # client creation, so it is set unconditionally — probing the
+        # backend here would force backend init BEFORE the rendezvous,
+        # which must come first. No-op where gloo is already the
+        # default; skipped where the option no longer exists.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except (ValueError, AttributeError):  # option absent/renamed
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -103,5 +133,36 @@ def put_global(arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
 
 def put_replicated(arr: np.ndarray, mesh: Mesh) -> jax.Array:
     return put_global(arr, NamedSharding(mesh, P()))
+
+
+_AGREE_FNS: dict = {}
+
+
+def agree_max(value: int, mesh: Mesh) -> int:
+    """Cross-process max of a per-process int — the trainer's preemption
+    flag agreement. Implemented as a device-per-slot global array (each
+    process contributes its own value via put_global) reduced by a jitted
+    max over the CALLER'S live mesh, instead of
+    multihost_utils.process_allgather: that helper builds a fresh global
+    mesh per call, which segfaults on jax 0.4.37's multi-process CPU
+    (gloo) backend after an orbax restore (observed in the dp:2proc gate
+    leg) — while collectives over the existing mesh, the exact machinery
+    every training step already exercises, are solid. Single-process:
+    the value itself."""
+    if jax.process_count() == 1:
+        return int(value)
+    import jax.numpy as jnp
+
+    n = int(np.prod(mesh.devices.shape))
+    # one slot per device, dim 0 sharded over EVERY mesh axis, so each
+    # process's addressable shards carry exactly its local value
+    spec = NamedSharding(mesh, P(mesh.axis_names))
+    garr = put_global(np.full((n,), value, np.int32), spec)
+    fn = _AGREE_FNS.get(mesh)
+    if fn is None:
+        fn = jax.jit(jnp.max,
+                     out_shardings=NamedSharding(mesh, P()))
+        _AGREE_FNS[mesh] = fn
+    return int(fn(garr))
 
 

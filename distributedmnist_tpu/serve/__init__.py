@@ -1,0 +1,33 @@
+"""Batched inference serving (ISSUE 1): the forward-only half of the
+north star's "serves heavy traffic from millions of users".
+
+- engine.py   bucketed, jitted, donated forward step over the 'data' mesh
+- batcher.py  dynamic micro-batcher with bounded-queue backpressure
+- metrics.py  latency percentiles / occupancy / qps, JSON-line records
+
+Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
+parent must not import jax.
+"""
+
+_EXPORTS = {
+    "InferenceEngine": ("distributedmnist_tpu.serve.engine",
+                        "InferenceEngine"),
+    "build_engine": ("distributedmnist_tpu.serve.engine", "build_engine"),
+    "make_buckets": ("distributedmnist_tpu.serve.engine", "make_buckets"),
+    "DynamicBatcher": ("distributedmnist_tpu.serve.batcher",
+                       "DynamicBatcher"),
+    "Rejected": ("distributedmnist_tpu.serve.batcher", "Rejected"),
+    "ServeMetrics": ("distributedmnist_tpu.serve.metrics", "ServeMetrics"),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(module_name), attr)

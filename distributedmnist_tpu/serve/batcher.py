@@ -1,0 +1,182 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into engine-sized
+batches, with bounded-queue backpressure.
+
+A single MNIST forward is ~microseconds of device time; serving requests
+one-at-a-time would be dispatch-bound exactly the way unfused training
+steps were (SURVEY.md §7.3). The batcher holds a thread-safe queue of
+pending requests and a single dispatch thread that coalesces whatever is
+waiting — up to `max_batch` rows or `max_wait_us` after the oldest
+request arrived, whichever comes first — into one engine.infer() call
+(which pads to the covering bucket), then fans the sliced results back
+out to per-request futures. Latency-throughput tradeoff in two knobs:
+`max_wait_us` bounds the queueing delay a lone request can suffer;
+`max_batch` bounds how much traffic one dispatch can absorb.
+
+Backpressure: admission is bounded by `queue_depth` PENDING rows. Beyond
+the watermark submit() raises Rejected (HTTP 503 semantics — serve.py
+maps it to exactly that) instead of letting queue delay grow without
+bound: under overload a closed feedback to the client keeps the p99 of
+ACCEPTED requests near the service time, where an unbounded queue would
+melt every request's latency together (the Clipper/Clockwork admission
+argument — PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+class Rejected(RuntimeError):
+    """Queue past its watermark: shed this request (503 semantics)."""
+
+    status = 503
+
+
+@dataclass
+class _Request:
+    x: np.ndarray                 # (n, 28, 28, 1) uint8
+    n: int
+    t_enqueue: float              # time.monotonic()
+    future: Future = field(default_factory=Future)
+
+
+class DynamicBatcher:
+    """Single dispatch thread over a bounded request queue.
+
+    start()/stop() manage the thread; submit(x) -> Future resolving to
+    the request's (n, 10) logits. All engine calls happen on the one
+    dispatch thread, so the engine itself needs no locking.
+    """
+
+    def __init__(self, engine, max_batch: Optional[int] = None,
+                 max_wait_us: int = 1000,
+                 queue_depth: int = 4096, metrics=None):
+        self.engine = engine
+        self.max_batch = min(max_batch or engine.max_batch,
+                             engine.buckets[-1])
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_wait_s = max_wait_us / 1e6
+        self.queue_depth = queue_depth
+        self.metrics = metrics
+        self._q: deque[_Request] = deque()
+        self._rows = 0                   # pending rows, watermark basis
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, x) -> Future:
+        """Enqueue up to max_batch rows; Future resolves to their logits.
+        Raises Rejected past the queue watermark (overload shedding) and
+        ValueError for requests no single dispatch could ever carry."""
+        x = self.engine._as_images(x)
+        n = x.shape[0]
+        if n > self.max_batch:
+            raise ValueError(
+                f"request of {n} rows exceeds max_batch={self.max_batch};"
+                " split it client-side")
+        req = _Request(x=x, n=n, t_enqueue=time.monotonic())
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("batcher is stopped")
+            if self._rows + n > self.queue_depth:
+                if self.metrics is not None:
+                    self.metrics.record_reject(n)
+                raise Rejected(
+                    f"queue at {self._rows} pending rows; watermark "
+                    f"{self.queue_depth} would be exceeded by {n} more")
+            self._q.append(req)
+            self._rows += n
+            self._cond.notify_all()
+        return req.future
+
+    def pending_rows(self) -> int:
+        with self._cond:
+            return self._rows
+
+    # -- dispatch side -----------------------------------------------------
+
+    def start(self) -> "DynamicBatcher":
+        if self._thread is not None:
+            raise RuntimeError("batcher already started")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the dispatch thread; drain=True serves what is already
+        queued first, drain=False fails pending futures."""
+        with self._cond:
+            self._stop = True
+            if not drain:
+                while self._q:
+                    req = self._q.popleft()
+                    self._rows -= req.n
+                    req.future.set_exception(
+                        RuntimeError("batcher stopped"))
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _take_batch(self) -> list[_Request]:
+        """Block until there is work, then coalesce: wait until max_batch
+        rows are pending or max_wait has elapsed since the OLDEST pending
+        request, then pop a prefix of the queue that fits max_batch.
+        Returns [] only when stopping with an empty queue."""
+        with self._cond:
+            while not self._q and not self._stop:
+                self._cond.wait(0.1)
+            if not self._q:
+                return []
+            deadline = self._q[0].t_enqueue + self.max_wait_s
+            while self._rows < self.max_batch and not self._stop:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch = []
+            taken = 0
+            while self._q and taken + self._q[0].n <= self.max_batch:
+                req = self._q.popleft()
+                taken += req.n
+                batch.append(req)
+            self._rows -= taken
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return
+            rows = sum(r.n for r in batch)
+            try:
+                x = (batch[0].x if len(batch) == 1
+                     else np.concatenate([r.x for r in batch]))
+                logits = self.engine.infer(x)
+            except Exception as e:   # fan the failure out, keep serving
+                for r in batch:
+                    r.future.set_exception(e)
+                continue
+            t_done = time.monotonic()
+            off = 0
+            for r in batch:
+                r.future.set_result(logits[off:off + r.n])
+                off += r.n
+            if self.metrics is not None:
+                self.metrics.record_batch(
+                    rows=rows, bucket=self.engine.bucket_for(rows),
+                    queue_depth=self.pending_rows())
+                for r in batch:
+                    self.metrics.record_latency(t_done - r.t_enqueue,
+                                                rows=r.n)

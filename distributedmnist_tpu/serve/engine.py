@@ -1,0 +1,213 @@
+"""Batched inference engine: a jitted forward-only step with a bucketed
+shape cache.
+
+The training hot path's winning disciplines transfer directly to serving
+(ISSUE 1): device-resident params, shape-stable compiled programs, and
+batch-shaped dispatch. Requests arrive at arbitrary sizes; compiling a
+forward program per size would recompile constantly, so sizes are rounded
+up to a fixed ladder of power-of-two **buckets** (each a multiple of the
+mesh's data-parallel width so the batch axis shards evenly). An n-row
+request pads to the smallest covering bucket, runs the ONE compiled
+program for that bucket, and slices the first n rows of the result —
+steady state therefore runs with zero recompiles, asserted in tests via
+utils.CompileCounter (jax.monitoring events), the same compile-stability
+contract the trainer's scanned superstep relies on.
+
+One engine serves one (model, dtype): the jitted forward is a single
+function whose per-bucket specializations are jit's own shape cache, and
+utils/compile_cache.py's persistent XLA cache makes bucket warmup after a
+process restart a disk hit instead of a recompile.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distributedmnist_tpu.utils import (CompileCounter,
+                                        enable_compilation_cache, round_up)
+
+log = logging.getLogger("distributedmnist_tpu")
+
+IMAGE_SHAPE = (28, 28, 1)
+IMAGE_SIZE = 28 * 28
+
+
+def make_buckets(max_batch: int, n_chips: int,
+                 min_bucket: int = 1) -> tuple[int, ...]:
+    """The bucket ladder: powers of two scaled to multiples of n_chips,
+    doubling from round_up(min_bucket, n_chips) until max_batch is
+    covered. The top bucket is the first rung >= max_batch, so every
+    admissible request size has a covering bucket."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    b = round_up(max(min_bucket, 1), n_chips)
+    ladder = [b]
+    while ladder[-1] < max_batch:
+        ladder.append(ladder[-1] * 2)
+    return tuple(ladder)
+
+
+class InferenceEngine:
+    """Forward-only inference over the 'data' mesh axis with pad-and-slice
+    batch bucketing.
+
+    infer(x) takes uint8 images, shape (n, 28, 28, 1) or (n, 784), and
+    returns float logits (n, 10). Rows pad with zeros up to the covering
+    bucket; padded rows are computed and discarded (their cost is the
+    occupancy loss the batcher's occupancy histogram makes visible).
+    """
+
+    def __init__(self, model, params, mesh, dtype=None,
+                 max_batch: int = 512,
+                 buckets: Optional[Sequence[int]] = None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributedmnist_tpu.parallel import replicated
+        from distributedmnist_tpu.parallel.mesh import DATA_AXIS
+
+        enable_compilation_cache()
+        self._compiles = CompileCounter.instance()
+        self.mesh = mesh
+        self.n_chips = int(np.prod(mesh.devices.shape))
+        self.dtype = dtype if dtype is not None else jnp.float32
+        self.max_batch = max_batch
+        self.buckets = (tuple(sorted(set(buckets))) if buckets
+                        else make_buckets(max_batch, self.n_chips))
+        if any(b % self.n_chips for b in self.buckets):
+            raise ValueError(
+                f"buckets {self.buckets} must be multiples of the "
+                f"data-parallel width {self.n_chips}")
+        self.params = jax.device_put(params, replicated(mesh))
+        self._x_sharding = NamedSharding(mesh, P(DATA_AXIS, None, None,
+                                                 None))
+        out_spec = NamedSharding(mesh, P(DATA_AXIS, None))
+
+        def forward(params, x_u8):
+            # cast + /255 in-step: fuses into the first conv/matmul, and
+            # the host->device copy stays uint8 (4x smaller than f32).
+            x = x_u8.astype(self.dtype) / 255.0
+            logits = model.apply({"params": params}, x)
+            return jax.lax.with_sharding_constraint(logits, out_spec)
+
+        # Donated input: the uint8 batch buffer is dead after the gather/
+        # cast, so XLA may reuse it (a no-op with a warning on backends
+        # without donation, e.g. CPU — harmless).
+        self._forward = jax.jit(forward, donate_argnums=1)
+
+    # -- bucketing ---------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket covering n rows."""
+        if n < 1:
+            raise ValueError(f"need at least one row, got {n}")
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} rows exceeds the top bucket "
+            f"{self.buckets[-1]} (raise max_batch)")
+
+    @staticmethod
+    def _as_images(x) -> np.ndarray:
+        x = np.asarray(x)
+        if x.dtype != np.uint8:
+            raise TypeError(f"expected uint8 pixels, got {x.dtype}")
+        if x.ndim == 2 and x.shape[1] == IMAGE_SIZE:
+            x = x.reshape(-1, *IMAGE_SHAPE)
+        if x.ndim != 4 or x.shape[1:] != IMAGE_SHAPE:
+            raise ValueError(
+                f"expected (n, 28, 28, 1) or (n, 784) images, "
+                f"got shape {x.shape}")
+        return x
+
+    # -- inference ---------------------------------------------------------
+
+    def infer(self, x) -> np.ndarray:
+        """Logits (n, 10) for n uint8 images; pad-and-slice through the
+        covering bucket. The np.asarray fetch is a device->host VALUE
+        fetch — the result bytes a client would be sent — so per-request
+        latency measured around infer() is honest end-to-end time (the
+        StepTimer.barrier argument)."""
+        import jax
+
+        x = self._as_images(x)
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        if n < b:
+            x = np.concatenate(
+                [x, np.zeros((b - n, *IMAGE_SHAPE), np.uint8)])
+        x_dev = jax.device_put(x, self._x_sharding)
+        logits = self._forward(self.params, x_dev)
+        return np.asarray(logits)[:n]
+
+    def warmup(self) -> int:
+        """Compile (or load from the persistent cache) every bucket's
+        program; returns the number of compile events the warmup cost.
+        After this, steady state is recompile-free by construction."""
+        before = self._compiles.snapshot()
+        for b in self.buckets:
+            self.infer(np.zeros((b, *IMAGE_SHAPE), np.uint8))
+        n = self._compiles.snapshot() - before
+        log.info("serve engine warm: %d buckets %s (%d compile events)",
+                 len(self.buckets), list(self.buckets), n)
+        return n
+
+    def compile_events(self) -> int:
+        """Process-wide compile-request count (utils.CompileCounter);
+        take deltas around a steady-state window to assert zero
+        recompiles."""
+        return self._compiles.snapshot()
+
+
+def build_engine(cfg) -> InferenceEngine:
+    """InferenceEngine from a Config: the model/dtype/mesh the training
+    CLI would build, params restored from cfg.checkpoint_dir when one
+    exists there (a served model is usually a trained one), fresh-init
+    otherwise (load harnesses measure throughput, not accuracy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import models, optim
+    from distributedmnist_tpu.parallel import get_devices, make_mesh
+    from distributedmnist_tpu.trainer import init_state
+
+    if cfg.model_parallel != 1:
+        raise ValueError(
+            "the serving engine shards over the 'data' axis only; "
+            f"model_parallel={cfg.model_parallel} is rejected rather "
+            "than silently ignored")
+    if cfg.grad_accum != 1:
+        raise ValueError(
+            f"grad_accum={cfg.grad_accum} is a training knob with no "
+            "meaning for forward-only serving — rejected rather than "
+            "silently ignored")
+    devices = get_devices(cfg.device, cfg.num_devices)
+    mesh = make_mesh(devices)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    model = models.build(cfg.model, dtype=dtype, fused=cfg.fused_kernels,
+                         platform=devices[0].platform, conv=cfg.conv_impl)
+    tx = optim.build(cfg.optimizer, cfg.learning_rate, cfg.momentum,
+                     flat=cfg.flat_optimizer)
+    state = init_state(jax.random.PRNGKey(cfg.seed), model, tx,
+                       jnp.zeros((1, 28, 28, 1)))
+    restored = False
+    if cfg.checkpoint_dir:
+        from distributedmnist_tpu.checkpoint import Checkpointer
+
+        from distributedmnist_tpu.parallel import replicated
+        state = jax.device_put(state, replicated(mesh))
+        ckpt = Checkpointer(cfg.checkpoint_dir)
+        try:
+            state, restored = ckpt.maybe_restore(state)
+        finally:
+            ckpt.close()
+        if restored:
+            log.info("serving params restored from step %d",
+                     int(state.step))
+    return InferenceEngine(model, state.params, mesh, dtype=dtype,
+                           max_batch=cfg.serve_max_batch)

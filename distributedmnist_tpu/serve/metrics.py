@@ -1,0 +1,106 @@
+"""Serving observability: per-request latency percentiles, queue depth,
+batch-occupancy histogram, and requests/sec — reported in the repo's
+JSON-line record shape (a dict with a "metric" key, serialized by
+MetricsLogger.summary_line) so utils/supervise.py acceptors can watch a
+serving process exactly the way they watch the bench.
+
+Occupancy is the serving-side analogue of MFU: rows actually served per
+bucket slot compiled-and-executed. A low-occupancy bucket histogram says
+max_wait_us is too small (batches dispatch before filling) or traffic is
+too bursty for the bucket ladder; the latency percentiles say what that
+coalescing costs each request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from distributedmnist_tpu.utils import MetricsLogger, percentiles
+
+
+class ServeMetrics:
+    """Thread-safe accumulator; snapshot() is a plain dict, record() the
+    JSON-line-ready form. reset() reopens the measurement window (the
+    bench resets between sweep points)."""
+
+    def __init__(self, max_latency_samples: int = 100_000):
+        self._lock = threading.Lock()
+        self._max_samples = max_latency_samples
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = time.monotonic()
+            self._lat_s: deque = deque(maxlen=self._max_samples)
+            self._requests = 0
+            self._rows = 0
+            self._batches = 0
+            self._rejected_requests = 0
+            self._rejected_rows = 0
+            self._occupancy: dict[int, list] = {}  # bucket -> [batches,
+            self._depth_sum = 0                    #            rows]
+            self._depth_max = 0
+
+    # -- recording hooks (called by the batcher) ---------------------------
+
+    def record_latency(self, seconds: float, rows: int = 1) -> None:
+        with self._lock:
+            self._lat_s.append(seconds)
+            self._requests += 1
+            self._rows += rows
+
+    def record_batch(self, rows: int, bucket: int,
+                     queue_depth: int) -> None:
+        with self._lock:
+            self._batches += 1
+            occ = self._occupancy.setdefault(bucket, [0, 0])
+            occ[0] += 1
+            occ[1] += rows
+            self._depth_sum += queue_depth
+            self._depth_max = max(self._depth_max, queue_depth)
+
+    def record_reject(self, rows: int = 1) -> None:
+        with self._lock:
+            self._rejected_requests += 1
+            self._rejected_rows += rows
+
+    # -- reporting ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            lat_ms = {k: (round(v * 1e3, 3) if v is not None else None)
+                      for k, v in percentiles(list(self._lat_s)).items()}
+            occupancy = {
+                str(b): {"batches": n, "rows": rows,
+                         "occupancy": round(rows / (n * b), 4)}
+                for b, (n, rows) in sorted(self._occupancy.items())}
+            return {
+                "window_s": round(elapsed, 3),
+                "requests": self._requests,
+                "rows": self._rows,
+                "batches": self._batches,
+                "requests_per_sec": round(self._requests / elapsed, 2),
+                "rows_per_sec": round(self._rows / elapsed, 2),
+                "latency_ms": lat_ms,
+                "batch_occupancy": occupancy,
+                "mean_rows_per_batch": (
+                    round(self._rows / self._batches, 2)
+                    if self._batches else None),
+                "queue_depth_mean": (
+                    round(self._depth_sum / self._batches, 2)
+                    if self._batches else None),
+                "queue_depth_max": self._depth_max,
+                "rejected_requests": self._rejected_requests,
+                "rejected_rows": self._rejected_rows,
+            }
+
+    def record(self) -> dict:
+        """The supervise-acceptable heartbeat record: a JSON-able dict
+        with the conventional 'metric' key."""
+        return {"metric": "serve_stats", **self.snapshot()}
+
+    def heartbeat_line(self) -> str:
+        return MetricsLogger.summary_line(self.record())

@@ -236,12 +236,15 @@ def _make_explicit_step(loss_fn, tx, mesh, grad_accum: int = 1):
         state, losses = jax.lax.scan(body, state, idx_block)
         return state, {"loss": losses[-1], "loss_mean": losses.mean()}
 
-    smapped = shard_map(
-        _local_block, mesh=mesh,
-        in_specs=(P(), P(), P(), P(None, DATA_AXIS)),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
+    specs = dict(mesh=mesh,
+                 in_specs=(P(), P(), P(), P(None, DATA_AXIS)),
+                 out_specs=(P(), P()))
+    try:
+        smapped = shard_map(_local_block, check_vma=False, **specs)
+    except TypeError:
+        # jax < 0.6 spells the replication-check knob check_rep; newer
+        # versions renamed it to check_vma and dropped the old name.
+        smapped = shard_map(_local_block, check_rep=False, **specs)
     return jax.jit(smapped, donate_argnums=0)
 
 
@@ -314,9 +317,13 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
     from distributedmnist_tpu.checkpoint import Checkpointer  # lazy: orbax
     from distributedmnist_tpu.utils import enable_compilation_cache
 
-    enable_compilation_cache()
+    # Rendezvous BEFORE enabling the compile cache: the cache helper
+    # gives each process of a multi-process run its own subdirectory
+    # (shared-dir corruption — see utils/compile_cache.py), and it can
+    # only know the process index once jax.distributed is live.
     multihost = distributed.maybe_initialize(
         cfg.coordinator_address, cfg.num_processes, cfg.process_id)
+    enable_compilation_cache()
     devices = get_devices(cfg.device, cfg.num_devices)
     n_chips = len(devices)
     mp = cfg.model_parallel
@@ -549,9 +556,12 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
         # no custom disposition leaks past fit(), and a SIGTERM during
         # the exchange terminates under the pre-existing disposition
         # (nothing is saved yet, so that is the right outcome).
-        from jax.experimental import multihost_utils
-        all_capable = bool(multihost_utils.process_allgather(
-            jnp.int32(1 if install else 0)).min())
+        # agree_max over the live mesh (NOT multihost_utils.process_
+        # allgather, which builds a fresh mesh per call and segfaults on
+        # some multi-process CPU backends — parallel/distributed.py):
+        # "all capable" == no process reports incapable.
+        all_capable = distributed.agree_max(
+            0 if install else 1, mesh) == 0
         if install and not all_capable:
             log.warning("graceful preemption disabled: not every process "
                         "can install the SIGTERM handler")
@@ -610,11 +620,9 @@ def fit(cfg: Config, data: Optional[dict] = None) -> dict:
                     # race them in a small host thread pool.
                     drain_inflight()
                     with timer.exclude():
-                        from jax.experimental import multihost_utils
-                        flags = multihost_utils.process_allgather(
-                            jnp.int32(0 if preempt_signum[0] is None
-                                      else 1))
-                        preempt_agreed[0] = bool(flags.max())
+                        preempt_agreed[0] = distributed.agree_max(
+                            0 if preempt_signum[0] is None else 1,
+                            mesh) == 1
 
                 if ckpt and crossed(prev, step, cfg.checkpoint_every):
                     # Same attribution rule: the save's device->host

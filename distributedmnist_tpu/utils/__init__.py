@@ -11,6 +11,9 @@ _EXPORTS = {
     "enable_compilation_cache": (
         "distributedmnist_tpu.utils.compile_cache",
         "enable_compilation_cache"),
+    "CompileCounter": (
+        "distributedmnist_tpu.utils.compile_cache", "CompileCounter"),
+    "percentiles": ("distributedmnist_tpu.utils.metrics", "percentiles"),
 }
 
 __all__ = list(_EXPORTS)

@@ -17,6 +17,43 @@ from __future__ import annotations
 import os
 
 
+class CompileCounter:
+    """Monotonic count of XLA compile requests in this process, observed
+    via jax.monitoring events. The serving engine's zero-recompile
+    contract is asserted against this: after bucket warmup, steady-state
+    inference must not grow the count (tests/test_serve_engine.py), the
+    same discipline the trainer's shape-stable superstep relies on.
+
+    jax.monitoring has no per-listener unregister, so the listener is
+    installed once per process (module singleton via instance()) and
+    consumers take snapshot deltas rather than owning the listener.
+    """
+
+    _instance: "CompileCounter | None" = None
+
+    def __init__(self):
+        self.count = 0
+
+        def _on_event(event: str, **kw) -> None:
+            # Both the in-memory executable path and the persistent cache
+            # path emit compile-tagged events on a compile REQUEST; a jit
+            # cache hit emits nothing — exactly the steady-state signal.
+            if "compile" in event:
+                self.count += 1
+
+        import jax
+        jax.monitoring.register_event_listener(_on_event)
+
+    @classmethod
+    def instance(cls) -> "CompileCounter":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def snapshot(self) -> int:
+        return self.count
+
+
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """Turn on jax's persistent compilation cache; returns the directory
     used, or None when disabled. Safe to call more than once."""
@@ -28,6 +65,26 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     repo_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     cache_dir = cache_dir or env or os.path.join(repo_root, ".jax_cache")
+    # Localhost multi-PROCESS runs (the gate/test topology) must not
+    # share one cache directory: concurrent writers + readers of the
+    # same entry files produce heap corruption inside XLA's cache
+    # deserialization on jax 0.4.37 ("corrupted size vs. prev_size",
+    # then a segfault in the next compile — observed in the dp:2proc
+    # restore leg). Suffix a per-process subdir when a multi-process
+    # rendezvous is live; real multi-host processes see different
+    # filesystems anyway, so the split only costs duplicate entries.
+    # Probed via distributed global_state, NOT jax.process_count(),
+    # which would force backend initialization from inside a config
+    # helper. Callers that want the suffix must therefore initialize
+    # jax.distributed BEFORE enabling the cache (trainer.fit does).
+    try:
+        from jax._src.distributed import global_state
+        if (global_state.client is not None
+                and (global_state.num_processes or 1) > 1):
+            cache_dir = os.path.join(
+                cache_dir, f"proc{global_state.process_id}")
+    except ImportError:  # private layout moved; keep the shared dir
+        pass
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # MNIST-scale executables are small and fast to compile on CPU; cache
     # everything that takes noticeable time, regardless of size.

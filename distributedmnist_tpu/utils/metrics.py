@@ -22,6 +22,20 @@ import jax
 log = logging.getLogger("distributedmnist_tpu")
 
 
+def percentiles(values, qs=(50, 95, 99)) -> dict:
+    """{f"p{q}": value} by linear interpolation over sorted `values`
+    (numpy's default quantile method). Empty input yields None per key —
+    a serving window with zero completed requests must not fake a zero
+    latency. Shared by serve/metrics.py and the bench's latency tables."""
+    import numpy as np
+
+    if len(values) == 0:
+        return {f"p{int(q)}": None for q in qs}
+    arr = np.asarray(values, dtype=np.float64)
+    out = np.quantile(arr, [q / 100.0 for q in qs])
+    return {f"p{int(q)}": float(v) for q, v in zip(qs, out)}
+
+
 class StepTimer:
     """Throughput accounting over the hot loop, excluding compile.
 

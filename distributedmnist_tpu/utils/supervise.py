@@ -42,9 +42,14 @@ def run_supervised(script: str, argv: list[str],
     """Run `python -u script *argv` as a worker (marked via env); kill +
     retry if it produces no output for stall_timeout seconds. `accept`
     maps worker stdout lines to the result to forward (or None if they
-    contain no valid result); it is called with successive chunks of
-    NEWLY-arrived lines — not the whole buffer — and the most recent
-    non-None result wins, so each line is scanned once per attempt.
+    contain no valid result); while the worker runs it is called with
+    successive chunks of NEWLY-arrived lines — not the whole buffer —
+    and the most recent non-None result wins, so each line is scanned
+    once per attempt. Acceptors must therefore be LINE-LOCAL (decide per
+    line, like json_record_acceptor): a record straddling a poll
+    boundary is split across chunks. As a safety net for acceptors that
+    do need cross-line context, after the worker exits with no chunk
+    result the whole buffer is re-scanned in ONE final accept() call.
     Returns the exit code; the accepted result is written to stdout.
     Never imports jax.
 
@@ -140,6 +145,14 @@ def run_supervised(script: str, argv: list[str],
             t.join(timeout=5)
 
         result = current_result()
+        if result is None and out_lines:
+            # Post-exit fallback: one full-buffer scan. Chunk-wise
+            # scanning is line-local by contract; an acceptor needing
+            # cross-line context (multi-line JSON, line pairs) would
+            # miss a record split across poll boundaries — after process
+            # exit the complete buffer exists, so scan it once (ADVICE
+            # r5).
+            result = accept(out_lines)
         if result is not None:
             sys.stdout.write(result)
             sys.stdout.flush()
@@ -152,10 +165,22 @@ def run_supervised(script: str, argv: list[str],
 
 def json_record_acceptor(required_key: str):
     """accept() factory: the last stdout line that parses as a JSON object
-    containing `required_key`."""
+    containing `required_key`.
+
+    LINE-LOCAL by design — each line is judged on its own, so the
+    acceptor is correct under run_supervised's chunk-wise delivery
+    (accept() sees only newly-arrived lines per poll, never the whole
+    buffer until the post-exit fallback). Any future acceptor that needs
+    cross-line context must rely on that post-exit full-buffer scan
+    instead."""
     import json
 
     def accept(out_lines: list[str]) -> Optional[str]:
+        # The line-local contract also means every element must BE one
+        # line; a caller handing in multi-line strings would defeat the
+        # chunking guarantee silently.
+        assert all("\n" not in line.rstrip("\n") for line in out_lines), \
+            "json_record_acceptor expects one line per list element"
         for line in reversed(out_lines):
             try:
                 rec = json.loads(line)

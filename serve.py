@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""serve.py — request-shaped inference entrypoint over the batched
+serving engine (distributedmnist_tpu/serve/), the forward-only sibling
+of train.py.
+
+Two modes:
+
+- selftest (default): drive --selftest N synthetic requests of mixed
+  sizes through the dynamic batcher in-process, then print one JSON
+  summary line ({"metric": "serve_selftest", ...}) — the cheap
+  end-to-end gate, and what `python serve.py` does out of the box.
+- --port P: serve HTTP on P (0 picks an ephemeral port, announced as a
+  {"metric": "serve_ready", "port": ...} JSON line on stdout). stdlib
+  http.server only — the container installs nothing.
+
+    POST /predict   body = raw uint8 pixels, n*784 bytes ->
+                    {"classes": [...], "n": n}
+                    503 + Retry-After when the queue is past its
+                    backpressure watermark (shed, don't melt)
+    GET  /metrics   current ServeMetrics snapshot (JSON)
+    GET  /healthz   {"ok": true}
+
+Periodic {"metric": "serve_stats", ...} heartbeat lines go to stdout
+(--metrics-every), so utils/supervise.py's json_record_acceptor can
+watch a serving process exactly as it watches the bench. SIGTERM/SIGINT
+shut the server down cleanly and print a final summary line.
+
+Model/params come from Config: --checkpoint-dir restores trained params
+(the usual serving case); otherwise params are fresh-init (load tests).
+Batching knobs: --serve-max-batch, --serve-max-wait-us,
+--serve-queue-depth (config.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import time
+
+from distributedmnist_tpu import config as config_lib
+
+IMAGE_BYTES = 28 * 28
+
+
+def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
+    import numpy as np
+
+    from distributedmnist_tpu.serve import Rejected
+
+    rng = np.random.default_rng(0)
+    sizes = [int(rng.integers(1, max(2, min(max_batch, 32))))
+             for _ in range(n_requests)]
+    futures = []
+    rejected = 0
+    for n in sizes:
+        x = rng.integers(0, 256, (n, IMAGE_BYTES), dtype=np.uint8)
+        try:
+            futures.append((n, batcher.submit(x)))
+        except Rejected:
+            rejected += 1
+    for n, f in futures:
+        out = f.result(timeout=120)
+        assert out.shape == (n, 10), (out.shape, n)
+    return {"metric": "serve_selftest", "requests_driven": n_requests,
+            "rejected_at_submit": rejected, **metrics.snapshot()}
+
+
+def _http_serve(batcher, metrics, engine, port: int,
+                metrics_every: float) -> dict:
+    import concurrent.futures
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from distributedmnist_tpu.serve import Rejected
+
+    max_body = engine.max_batch * IMAGE_BYTES
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # requests are metered, not
+            pass                             # per-line logged
+
+        def _send(self, code: int, payload: dict,
+                  extra: dict | None = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"ok": True})
+            elif self.path == "/metrics":
+                self._send(200, metrics.record())
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            if length == 0 or length % IMAGE_BYTES:
+                self._send(400, {"error": "body must be n*784 raw "
+                                          "uint8 pixel bytes"})
+                return
+            if length > max_body:
+                self._send(413, {"error": f"at most {engine.max_batch} "
+                                          "images per request"})
+                return
+            import numpy as np
+            raw = self.rfile.read(length)
+            x = np.frombuffer(raw, np.uint8).reshape(-1, IMAGE_BYTES)
+            try:
+                logits = batcher.submit(x).result(timeout=60)
+            except Rejected:
+                self._send(503, {"error": "overloaded; retry"},
+                           extra={"Retry-After": "1"})
+                return
+            except concurrent.futures.TimeoutError:
+                self._send(504, {"error": "inference timed out"})
+                return
+            except Exception as e:   # engine fan-out / batcher stopped:
+                # an HTTP error beats a dropped keep-alive connection
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {"classes": logits.argmax(-1).tolist(),
+                             "n": int(x.shape[0])})
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    bound = srv.server_address[1]
+    print(json.dumps({"metric": "serve_ready", "port": bound}),
+          flush=True)
+
+    stop = threading.Event()
+
+    def _beat():
+        while not stop.wait(metrics_every):
+            print(metrics.heartbeat_line(), flush=True)
+
+    beat = threading.Thread(target=_beat, daemon=True)
+    beat.start()
+
+    def _shutdown(signum, frame):
+        # shutdown() must come from another thread than serve_forever()
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    try:
+        srv.serve_forever(poll_interval=0.2)
+    finally:
+        stop.set()
+        srv.server_close()
+    return {"metric": "serve_summary", "port": bound,
+            **metrics.snapshot()}
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    config_lib.add_args(p)
+    p.add_argument("--port", type=int, default=None,
+                   help="serve HTTP on this port (0 = ephemeral, "
+                        "announced on stdout); omit for selftest mode")
+    p.add_argument("--selftest", type=int, default=None, metavar="N",
+                   help="run N synthetic requests through the batcher "
+                        "and exit (default mode, N=256)")
+    p.add_argument("--metrics-every", type=float, default=10.0,
+                   help="seconds between serve_stats heartbeat lines")
+    args = p.parse_args(argv)
+    if args.port is not None and args.selftest is not None:
+        p.error("--port and --selftest are mutually exclusive")
+    cfg = config_lib.from_args(args)
+
+    from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
+                                            build_engine)
+
+    engine = build_engine(cfg)
+    t0 = time.perf_counter()
+    engine.warmup()
+    logging.getLogger("distributedmnist_tpu").info(
+        "buckets %s warm in %.2fs", list(engine.buckets),
+        time.perf_counter() - t0)
+    metrics = ServeMetrics()
+    batcher = DynamicBatcher(engine, max_batch=cfg.serve_max_batch,
+                             max_wait_us=cfg.serve_max_wait_us,
+                             queue_depth=cfg.serve_queue_depth,
+                             metrics=metrics).start()
+    try:
+        if args.port is None:
+            summary = _selftest(batcher, metrics, args.selftest or 256,
+                                engine.max_batch)
+        else:
+            summary = _http_serve(batcher, metrics, engine, args.port,
+                                  args.metrics_every)
+    finally:
+        batcher.stop()
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,10 +86,16 @@ def test_auto_equals_explicit_mode(tiny_data, eight_devices, model_name, opt):
     s_exp, l_exp = _run(tiny_data, eight_devices, 5, "explicit",
                         model_name, opt)
     np.testing.assert_allclose(l_auto, l_exp, rtol=1e-5)
+    # rtol 1e-4, not 1e-5: the two modes lower the gradient all-reduce
+    # differently (XLA-inserted vs explicit psum), and their reduction
+    # orders differ at the ulp level across jax versions. Adam divides
+    # by sqrt(nu), amplifying that over 5 steps to ~2.5e-5 relative on
+    # LeNet (observed on jax 0.4.37 CPU); mlp-sgd stays tighter. Still
+    # a strong equivalence bound — a real divergence is orders beyond.
     for a, b in zip(jax.tree.leaves(s_auto.params),
                     jax.tree.leaves(s_exp.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-4, atol=1e-6)
 
 
 @pytest.mark.parametrize("mode", ["auto", "explicit"])

@@ -1,0 +1,190 @@
+"""serve/batcher.py: coalescing under max_wait_us, max_batch-triggered
+flush, per-request fan-out correctness, bounded-queue backpressure
+(Rejected at the watermark), and metrics recording — all against a stub
+engine with a controllable infer(), so the batching logic is tested in
+isolation from jax."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import DynamicBatcher, Rejected, ServeMetrics
+from distributedmnist_tpu.serve.engine import InferenceEngine
+
+
+class StubEngine:
+    """Engine-shaped test double. infer() returns each row's first 10
+    pixel values as float 'logits', so a request's result identifies
+    exactly which input rows it was served from. An optional gate Event
+    makes dispatch block deterministically (backpressure tests)."""
+
+    def __init__(self, max_batch=16, n_chips=4, gate=None):
+        self.max_batch = max_batch
+        self.buckets = tuple(n_chips * 2 ** i for i in range(
+            max(1, (max_batch // n_chips).bit_length())))
+        while self.buckets[-1] < max_batch:
+            self.buckets += (self.buckets[-1] * 2,)
+        self.gate = gate
+        self.calls = []            # row counts per infer() call
+        self.in_call = threading.Event()
+
+    _as_images = staticmethod(InferenceEngine._as_images)
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(n)
+
+    def infer(self, x):
+        self.calls.append(x.shape[0])
+        self.in_call.set()
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30)
+        return x.reshape(x.shape[0], -1)[:, :10].astype(np.float32)
+
+
+def _rows(rng, n):
+    return rng.integers(0, 256, (n, 28, 28, 1)).astype(np.uint8)
+
+
+def test_coalesces_waiting_requests_into_one_dispatch(rng):
+    eng = StubEngine(max_batch=16)
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=256).start()
+    try:
+        # first submit occupies the dispatch thread at the gate; the next
+        # three queue up behind it and MUST coalesce into one batch
+        first = b.submit(_rows(rng, 1))
+        assert eng.in_call.wait(timeout=10)
+        futs = [b.submit(_rows(rng, 2)) for _ in range(3)]
+        gate.set()
+        first.result(timeout=10)
+        for f in futs:
+            assert f.result(timeout=10).shape == (2, 10)
+        assert eng.calls[0] == 1
+        assert eng.calls[1] == 6, (
+            f"expected one coalesced 6-row dispatch, got {eng.calls}")
+    finally:
+        b.stop()
+
+
+def test_full_batch_flushes_before_max_wait(rng):
+    """max_batch rows pending dispatch immediately — a 5-second wait
+    bound must NOT be paid when the batch is already full."""
+    eng = StubEngine(max_batch=8)
+    b = DynamicBatcher(eng, max_wait_us=5_000_000, queue_depth=256).start()
+    try:
+        t0 = time.monotonic()
+        futs = [b.submit(_rows(rng, 4)) for _ in range(2)]   # = max_batch
+        for f in futs:
+            f.result(timeout=10)
+        assert time.monotonic() - t0 < 2.0, (
+            "a full batch waited for the coalescing deadline")
+    finally:
+        b.stop()
+
+
+def test_lone_request_is_served_within_the_wait_bound(rng):
+    eng = StubEngine(max_batch=16)
+    b = DynamicBatcher(eng, max_wait_us=10_000, queue_depth=256).start()
+    try:
+        out = b.submit(_rows(rng, 3)).result(timeout=10)
+        assert out.shape == (3, 10)
+        assert eng.calls == [3]
+    finally:
+        b.stop()
+
+
+def test_fan_out_maps_each_request_to_its_own_rows(rng):
+    """Coalesce-then-slice must hand every request exactly its own rows'
+    results, in its own order — the stub's identity 'logits' make any
+    off-by-one or reordering visible."""
+    eng = StubEngine(max_batch=32)
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=256).start()
+    try:
+        b.submit(_rows(rng, 1))          # occupy dispatch at the gate
+        assert eng.in_call.wait(timeout=10)
+        xs = [_rows(rng, n) for n in (3, 1, 5)]
+        futs = [b.submit(x) for x in xs]
+        gate.set()
+        for x, f in zip(xs, futs):
+            want = x.reshape(x.shape[0], -1)[:, :10].astype(np.float32)
+            np.testing.assert_array_equal(f.result(timeout=10), want)
+    finally:
+        b.stop()
+
+
+def test_backpressure_rejects_past_watermark_and_recovers(rng):
+    metrics = ServeMetrics()
+    eng = StubEngine(max_batch=4)
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=8,
+                       metrics=metrics).start()
+    try:
+        b.submit(_rows(rng, 4))          # in dispatch, blocked at gate
+        assert eng.in_call.wait(timeout=10)
+        ok = [b.submit(_rows(rng, 4)), b.submit(_rows(rng, 4))]  # 8 pending
+        with pytest.raises(Rejected):
+            b.submit(_rows(rng, 1))      # watermark exceeded -> shed
+        assert metrics.snapshot()["rejected_requests"] == 1
+        gate.set()                       # drain
+        for f in ok:
+            f.result(timeout=10)
+        # queue drained: admission works again
+        assert b.submit(_rows(rng, 2)).result(timeout=10).shape == (2, 10)
+    finally:
+        b.stop()
+
+
+def test_oversized_request_is_a_client_error(rng):
+    eng = StubEngine(max_batch=8)
+    b = DynamicBatcher(eng, queue_depth=64).start()
+    try:
+        with pytest.raises(ValueError, match="max_batch"):
+            b.submit(_rows(rng, 9))
+    finally:
+        b.stop()
+
+
+def test_stop_without_drain_fails_pending_futures(rng):
+    eng = StubEngine(max_batch=4)
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=64).start()
+    b.submit(_rows(rng, 4))
+    assert eng.in_call.wait(timeout=10)
+    pending = b.submit(_rows(rng, 2))
+    b.stop(drain=False)
+    gate.set()
+    with pytest.raises(RuntimeError, match="stopped"):
+        pending.result(timeout=10)
+    with pytest.raises(RuntimeError, match="stopped"):
+        b.submit(_rows(rng, 1))
+
+
+def test_metrics_record_occupancy_and_latency(rng):
+    metrics = ServeMetrics()
+    eng = StubEngine(max_batch=16)
+    b = DynamicBatcher(eng, max_wait_us=5000, queue_depth=64,
+                       metrics=metrics).start()
+    try:
+        for _ in range(4):
+            b.submit(_rows(rng, 2)).result(timeout=10)
+    finally:
+        b.stop()
+    snap = metrics.snapshot()
+    assert snap["requests"] == 4 and snap["rows"] == 8
+    assert snap["latency_ms"]["p50"] is not None
+    assert snap["latency_ms"]["p99"] >= snap["latency_ms"]["p50"]
+    occ = snap["batch_occupancy"]
+    assert occ, "occupancy histogram empty"
+    assert sum(v["rows"] for v in occ.values()) == 8
+    for v in occ.values():
+        assert 0 < v["occupancy"] <= 1

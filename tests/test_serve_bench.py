@@ -1,0 +1,157 @@
+"""CLI contracts of the serving stack: `bench.py serve` (positional mode
+spelling included) emits the one-line serve_images_per_sec_per_chip
+record with latency percentiles, occupancy and a recompile-free steady
+state; serve.py's selftest and HTTP modes run end-to-end on CPU; flag
+validation rejects cross-mode misuse before any backend comes up."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests.conftest import worker_env
+
+
+def _run_cli(script, extra, timeout=600):
+    env, repo = worker_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, script)] + extra,
+        capture_output=True, text=True, env=env, cwd=repo,
+        timeout=timeout)
+
+
+SERVE_ARGS = ["--inline", "--model", "mlp", "--serve-duration", "0.5",
+              "--serve-qps", "40", "--serve-clients", "2",
+              "--serve-max-batch", "16", "--serve-max-wait-us", "2000"]
+
+
+def test_bench_serve_contract():
+    """`python bench.py serve` (the acceptance-criteria spelling)
+    completes a QPS sweep and emits the parseable record — including
+    p50/p95/p99, batch occupancy, and zero steady-state recompiles."""
+    out = _run_cli("bench.py", ["serve"] + SERVE_ARGS)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE JSON line, got {out.stdout!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "detail"}
+    assert rec["metric"] == "serve_images_per_sec_per_chip"
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    d = rec["detail"]
+    # steady state after bucket warmup must be recompile-free
+    assert d["warmup_compile_events"] > 0
+    assert d["recompiles_after_warmup"] == 0
+    closed = d["closed_loop"]
+    for q in ("p50", "p95", "p99"):
+        assert closed["latency_ms"][q] is not None
+    assert closed["batch_occupancy"], "no occupancy histogram"
+    assert closed["rows_per_sec"] > 0
+    # the open-loop sweep ran and carries the latency-vs-throughput table
+    assert len(d["qps_sweep"]) == 1
+    point = d["qps_sweep"][0]
+    assert point["qps_target"] == 40.0
+    assert point["latency_ms"]["p99"] is not None
+    assert point["img_s_chip"] > 0
+    assert d["buckets"] == [8, 16]
+
+
+def test_bench_serve_rejects_training_flags():
+    out = _run_cli("bench.py", ["serve", "--repeats", "2"], timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("bench.py", ["serve", "--global-batch", "64"],
+                   timeout=60)
+    assert out.returncode == 2
+
+
+def test_bench_training_modes_reject_serve_flags():
+    out = _run_cli("bench.py", ["--serve-qps", "100"], timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("bench.py", ["smoke", "--serve-clients", "4"],
+                   timeout=60)
+    assert out.returncode == 2
+
+
+def test_bench_positional_mode_conflict_rejected():
+    out = _run_cli("bench.py", ["serve", "--mode", "smoke"], timeout=60)
+    assert out.returncode == 2
+
+
+def test_serve_selftest_contract():
+    out = _run_cli("serve.py", ["--model", "mlp", "--device", "cpu",
+                                "--serve-max-batch", "16",
+                                "--selftest", "32"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.splitlines()[-1])
+    assert rec["metric"] == "serve_selftest"
+    assert rec["requests_driven"] == 32
+    assert rec["rows"] > 0 and rec["batches"] > 0
+    assert rec["latency_ms"]["p50"] is not None
+    assert rec["batch_occupancy"]
+
+
+def test_serve_http_end_to_end():
+    """serve.py --port 0: ready announcement, POST /predict, /metrics
+    heartbeat shape, 400 on a malformed body, SIGTERM -> clean summary.
+    The metrics lines carry the conventional 'metric' key, so a
+    supervise.json_record_acceptor sees a serving process as alive."""
+    env, repo = worker_env()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "serve.py"), "--model", "mlp",
+         "--device", "cpu", "--serve-max-batch", "16", "--port", "0",
+         "--metrics-every", "0.5"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=repo)
+    port = None
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, "serve.py exited before announcing readiness"
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "serve_ready":
+                port = rec["port"]
+                break
+        assert port, "no serve_ready line"
+        base = f"http://127.0.0.1:{port}"
+
+        body = np.full((3, 784), 128, np.uint8).tobytes()
+        r = json.loads(urllib.request.urlopen(
+            f"{base}/predict", data=body, timeout=30).read())
+        assert r["n"] == 3 and len(r["classes"]) == 3
+        assert all(0 <= c <= 9 for c in r["classes"])
+
+        m = json.loads(urllib.request.urlopen(
+            f"{base}/metrics", timeout=10).read())
+        assert m["metric"] == "serve_stats" and m["requests"] >= 1
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/predict", data=b"not-784",
+                                   timeout=10)
+        assert ei.value.code == 400
+
+        ok = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=10).read())
+        assert ok == {"ok": True}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert proc.returncode == 0
+    records = [json.loads(l) for l in out.splitlines() if l.strip()]
+    summary = [r for r in records if r.get("metric") == "serve_summary"]
+    assert summary and summary[-1]["requests"] >= 1

@@ -1,0 +1,93 @@
+"""serve/engine.py: bucket ladder, pad-and-slice correctness against the
+direct forward, input validation, and the steady-state zero-recompile
+contract (utils.CompileCounter over jax.monitoring events)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedmnist_tpu import models
+from distributedmnist_tpu.parallel import make_mesh
+from distributedmnist_tpu.serve import InferenceEngine, make_buckets
+from distributedmnist_tpu.trainer import init_state
+
+
+def test_make_buckets_ladder():
+    assert make_buckets(64, 8) == (8, 16, 32, 64)
+    assert make_buckets(1, 1) == (1,)
+    assert make_buckets(5, 1) == (1, 2, 4, 8)       # top covers max_batch
+    assert make_buckets(100, 8) == (8, 16, 32, 64, 128)
+    assert make_buckets(16, 3) == (3, 6, 12, 24)    # chips-multiple rungs
+
+
+@pytest.fixture(scope="module")
+def engine(eight_devices):
+    mesh = make_mesh(eight_devices)
+    model = models.build("mlp", platform="cpu")
+    params = init_state(jax.random.PRNGKey(0), model, _sgd(),
+                        jnp.zeros((1, 28, 28, 1))).params
+    eng = InferenceEngine(model, params, mesh, max_batch=32)
+    eng.warmup()
+    return eng
+
+
+def _sgd():
+    from distributedmnist_tpu import optim
+    return optim.build("sgd", 0.1)
+
+
+def test_bucket_for_smallest_covering(engine):
+    assert engine.buckets == (8, 16, 32)
+    assert engine.bucket_for(1) == 8
+    assert engine.bucket_for(8) == 8
+    assert engine.bucket_for(9) == 16
+    assert engine.bucket_for(32) == 32
+    with pytest.raises(ValueError, match="top bucket"):
+        engine.bucket_for(33)
+    with pytest.raises(ValueError):
+        engine.bucket_for(0)
+
+
+def test_input_validation(engine):
+    with pytest.raises(TypeError, match="uint8"):
+        engine.infer(np.zeros((2, 28, 28, 1), np.float32))
+    with pytest.raises(ValueError, match="images"):
+        engine.infer(np.zeros((2, 27, 28, 1), np.uint8))
+    # flat (n, 784) rows are accepted and reshaped
+    assert engine.infer(np.zeros((2, 784), np.uint8)).shape == (2, 10)
+
+
+def test_pad_and_slice_roundtrip_matches_direct_forward(engine, rng):
+    """An n-row request padded to its covering bucket must return exactly
+    the logits the unpadded forward computes for those n rows — padding
+    can never contaminate real rows, and slicing must keep order."""
+    x = rng.integers(0, 256, (11, 28, 28, 1)).astype(np.uint8)
+    got = engine.infer(x)
+    assert got.shape == (11, 10)
+
+    model = models.build("mlp", platform="cpu")
+    ref = model.apply({"params": jax.device_get(engine.params)},
+                      x.astype(np.float32) / 255.0)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_steady_state_runs_with_zero_recompiles(engine, rng):
+    """The acceptance contract: after bucket warmup, a mixed-size request
+    stream stays entirely inside the compiled bucket set — the compile
+    counter (jax.monitoring events) must not move."""
+    # one extra pass over every bucket first: the fixture warmup already
+    # compiled them, so this is pure cache-hit traffic
+    before = engine.compile_events()
+    for n in [1, 3, 7, 8, 9, 15, 16, 17, 30, 32, 5, 12, 27]:
+        x = rng.integers(0, 256, (n, 28, 28, 1)).astype(np.uint8)
+        assert engine.infer(x).shape == (n, 10)
+    assert engine.compile_events() - before == 0, (
+        "steady-state serving recompiled despite bucketed shapes")
+
+
+def test_warmup_is_idempotent(engine):
+    """A second warmup over already-compiled buckets costs zero compile
+    events (in-memory jit cache hit — the restart case additionally goes
+    through the persistent cache)."""
+    assert engine.warmup() == 0

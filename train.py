@@ -64,10 +64,15 @@ def main(argv=None) -> int:
         # RE-DELIVERED after the summary line so process-level semantics
         # stay conventional for external orchestrators (exit status reads
         # terminated-by-SIGTERM, and nothing after fit() keeps running
-        # when the scheduler asked us to stop). fit() restored the
-        # default disposition before returning, so this terminates.
+        # when the scheduler asked us to stop). fit() restored the PRIOR
+        # disposition, which is not necessarily one that terminates: a
+        # parent that spawned us under nohup/a supervisor may have left
+        # SIG_IGN inherited, making the re-delivery a silent no-op
+        # (ADVICE r5). The intent here is unconditional conventional
+        # termination, so pin SIG_DFL explicitly first.
         import os
         import signal
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
         sys.stdout.flush()
         os.kill(os.getpid(), signal.SIGTERM)
     return 0
