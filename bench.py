@@ -156,6 +156,16 @@ def main(argv=None) -> int:
     p.add_argument("--serve-queue-depth", type=int, default=None,
                    help="[serve] backpressure watermark in pending rows "
                         "(default 4096)")
+    p.add_argument("--serve-max-inflight", type=int, default=None,
+                   help="[serve] pipelined dispatch window for the "
+                        "headline phase (default 4); the capacity phase "
+                        "always also runs at 1 for the serial baseline")
+    p.add_argument("--artifact-dir", default=None,
+                   help="[serve] directory for the BENCH_serve_r*.json "
+                        "artifact (default: bench.py's own directory)")
+    p.add_argument("--no-artifact", action="store_true", default=None,
+                   help="[serve] don't write the BENCH_serve_r*.json "
+                        "artifact")
     args = p.parse_args(argv)
 
     # Cheap arg-only validation FIRST: a deterministic usage error must
@@ -172,7 +182,10 @@ def main(argv=None) -> int:
                    "--serve-clients": args.serve_clients,
                    "--serve-max-batch": args.serve_max_batch,
                    "--serve-max-wait-us": args.serve_max_wait_us,
-                   "--serve-queue-depth": args.serve_queue_depth}
+                   "--serve-queue-depth": args.serve_queue_depth,
+                   "--serve-max-inflight": args.serve_max_inflight,
+                   "--artifact-dir": args.artifact_dir,
+                   "--no-artifact": args.no_artifact}
     if args.mode != "serve":
         given = [k for k, v in serve_flags.items() if v is not None]
         if given or args.serve_rows != 1:
@@ -199,6 +212,9 @@ def main(argv=None) -> int:
                     "(0 = no coalescing wait)")
         if args.serve_queue_depth is not None and args.serve_queue_depth < 1:
             p.error("--serve-queue-depth must be >= 1")
+        if (args.serve_max_inflight is not None
+                and args.serve_max_inflight < 1):
+            p.error("--serve-max-inflight must be >= 1")
         if args.serve_duration is not None and args.serve_duration <= 0:
             p.error("--serve-duration must be > 0")
         if args.serve_clients is not None and args.serve_clients < 1:
@@ -211,6 +227,18 @@ def main(argv=None) -> int:
                 p.error("--serve-qps must be comma-separated numbers")
             if not args.serve_qps or args.serve_qps[0] <= 0:
                 p.error("--serve-qps targets must be positive")
+        # LAST among the validations (its mkdir is a side effect; every
+        # pure usage error above must fire first): fail a bad artifact
+        # dir NOW — discovering it after the multi-minute load phases
+        # would lose the whole record.
+        if args.artifact_dir is not None and not args.no_artifact:
+            if not args.artifact_dir:
+                p.error("--artifact-dir needs a non-empty path "
+                        "(or use --no-artifact)")
+            try:
+                os.makedirs(args.artifact_dir, exist_ok=True)
+            except OSError as e:
+                p.error(f"--artifact-dir {args.artifact_dir!r}: {e}")
     elif args.mode in ("throughput", "sweep"):
         if args.trials is not None:
             p.error("--trials is a time-to-accuracy flag; throughput/"
@@ -699,21 +727,128 @@ def _smoke(args) -> int:
     return 0
 
 
-def _serve(args) -> int:
-    """Serving load harness: closed-loop capacity (the headline
-    images/sec/chip) plus an open-loop Poisson QPS sweep giving the
-    latency-vs-throughput table. Same perf discipline as the training
-    bench: bucket warmup (compile) excluded from every window, per-chip
-    normalization, and a recompile counter proving steady state ran
-    shape-stable."""
-    import random
+def _serve_closed_loop(batcher, metrics, req, clients: int,
+                       duration: float) -> dict:
+    """Closed loop: each client waits for its result before the next
+    submit, so concurrency == clients and the batcher coalesces to its
+    natural occupancy — serving capacity, not queue-melt throughput.
+    A short unmeasured ramp absorbs phase cold-start (client thread
+    spawn, allocator warmup) so back-to-back phases compare fairly."""
     import threading
 
+    from distributedmnist_tpu.serve import Rejected
+
+    client_errors: list = []
+    ramp = min(0.5, duration * 0.2)
+    stop_at = time.monotonic() + ramp + duration
+
+    def client():
+        while time.monotonic() < stop_at:
+            try:
+                batcher.submit(req).result(timeout=120)
+            except Rejected:
+                time.sleep(0.001)   # shed: brief client backoff
+            except BaseException as e:
+                # A dead client thread deflates the capacity headline
+                # silently; record and fail the bench after join.
+                client_errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(ramp)
+    metrics.reset()                  # measurement starts post-ramp
+    for t in threads:
+        t.join()
+    if client_errors:
+        raise RuntimeError(
+            f"{len(client_errors)} of {clients} closed-loop clients "
+            "died; the capacity headline would be measured against a "
+            "degraded pool") from client_errors[0]
+    # Clients unblock at set_result, BEFORE the completion thread
+    # records the batch's metrics — wait for the in-flight count (which
+    # drops only after metrics land) so the final batch's samples are in
+    # THIS snapshot, not leaked past the next phase's reset().
+    _drain_or_die(batcher, timeout=120)
+    return metrics.snapshot()
+
+
+def _drain_or_die(batcher, timeout: float) -> None:
+    """Bounded wait for the pipeline to fully drain (empty queue AND
+    zero in-flight, which the batcher guarantees means every future
+    resolved and every metrics record landed). A wedged pipeline fails
+    the bench instead of hanging it."""
+    deadline = time.monotonic() + timeout
+    while batcher.pending_rows() or batcher.inflight_batches():
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"serve pipeline failed to drain within {timeout:g}s "
+                f"({batcher.pending_rows()} rows pending, "
+                f"{batcher.inflight_batches()} batches in flight) — "
+                "wedged dispatch/fetch?")
+        time.sleep(0.005)
+
+
+def _serve_open_loop(batcher, metrics, req, qps: float, duration: float,
+                     max_wait_us: int) -> tuple[int, dict]:
+    """Open loop: Poisson arrivals at the target QPS. Submissions don't
+    wait for results (metrics record latency at completion), so queue
+    growth and backpressure rejections are visible exactly when the
+    target exceeds capacity. Returns (submitted, metrics snapshot) after
+    the queue and in-flight window have drained."""
+    import random
+
+    from distributedmnist_tpu.serve import Rejected
+
+    arrivals = random.Random(0)
+    metrics.reset()
+    t_end = time.monotonic() + duration
+    next_t = time.monotonic()
+    submitted = 0
+    while next_t < t_end:
+        now = time.monotonic()
+        if next_t > now:
+            time.sleep(next_t - now)
+        try:
+            batcher.submit(req)
+            submitted += 1
+        except Rejected:
+            pass                # recorded by metrics
+        next_t += arrivals.expovariate(qps)
+    _drain_or_die(batcher, timeout=120 + max_wait_us / 1e6)
+    return submitted, metrics.snapshot()
+
+
+def _next_serve_artifact(artifact_dir: str) -> str:
+    """Next free BENCH_serve_r*.json path: the serve perf trajectory,
+    one artifact per bench run, machine-readable like the committed
+    BENCH_r*/THROUGHPUT_r* training records."""
+    import re
+
+    rounds = [int(m.group(1)) for f in os.listdir(artifact_dir)
+              for m in [re.match(r"BENCH_serve_r(\d+)\.json$", f)] if m]
+    n = (max(rounds) if rounds else 0) + 1
+    return os.path.join(artifact_dir, f"BENCH_serve_r{n:02d}.json")
+
+
+def _serve(args) -> int:
+    """Serving load harness: closed-loop capacity (the headline
+    images/sec/chip) measured at the pipelined in-flight window AND at
+    the serial inflight=1 baseline — the overlap win is a measured
+    ratio, not a claim — plus an open-loop Poisson QPS sweep giving the
+    latency-vs-throughput table (with an inflight=1 p99 comparison point
+    at the lowest, sub-capacity target). Same perf discipline as the
+    training bench: bucket warmup (compile) excluded from every window,
+    per-chip normalization, and a recompile counter proving steady state
+    ran shape-stable. The whole record is also written to a
+    BENCH_serve_r*.json artifact (--artifact-dir / --no-artifact)."""
     import numpy as np
 
     from distributedmnist_tpu.config import Config
-    from distributedmnist_tpu.serve import (DynamicBatcher, Rejected,
-                                            ServeMetrics, build_engine)
+    from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
+                                            build_engine)
 
     cfg = Config(model=args.model, dtype=args.dtype)
     # Resolve backend-dependent defaults AFTER the engine is up (the
@@ -744,80 +879,61 @@ def _serve(args) -> int:
                   else [1000.0, 4000.0, 16000.0])
                  if args.serve_qps is None else args.serve_qps)
     rows = args.serve_rows
+    # The headline phase's pipeline depth. Unlike serve.py's auto rule
+    # (1 on CPU), the bench defaults to a real window even on CPU: the
+    # whole point of this harness is to MEASURE the overlap win against
+    # the always-run inflight=1 serial baseline.
+    pipelined = (4 if args.serve_max_inflight is None
+                 else args.serve_max_inflight)
 
     _mark(f"warming {len(engine.buckets)} buckets {list(engine.buckets)}")
     warm_compiles = engine.warmup()
     steady_from = engine.compile_events()
 
     metrics = ServeMetrics()
-    batcher = DynamicBatcher(engine, max_batch=engine.max_batch,
-                             max_wait_us=max_wait_us,
-                             queue_depth=queue_depth,
-                             metrics=metrics).start()
     rng = np.random.default_rng(0)
     req = rng.integers(0, 256, (rows, 28, 28, 1), dtype=np.uint8)
 
-    # Closed loop: each client waits for its result before the next
-    # submit, so concurrency == clients and the batcher coalesces to its
-    # natural occupancy — serving capacity, not queue-melt throughput.
-    client_errors: list = []
+    def make_batcher(max_inflight: int) -> DynamicBatcher:
+        return DynamicBatcher(engine, max_batch=engine.max_batch,
+                              max_wait_us=max_wait_us,
+                              queue_depth=queue_depth,
+                              max_inflight=max_inflight,
+                              metrics=metrics).start()
 
-    def client(stop_at: float):
-        while time.monotonic() < stop_at:
-            try:
-                batcher.submit(req).result(timeout=120)
-            except Rejected:
-                time.sleep(0.001)   # shed: brief client backoff
-            except BaseException as e:
-                # A dead client thread deflates the capacity headline
-                # silently; record and fail the bench after join.
-                client_errors.append(e)
-                return
+    # Phase 1 — serial baseline: inflight=1 is the pre-pipeline chain
+    # (stage, dispatch, fetch, fan out, repeat), the honest denominator
+    # of the overlap win; plus one sub-capacity open-loop point so the
+    # pipelined p99 has a latency comparison, not just a rate one.
+    low_qps = min(qps_sweep)
+    serial = make_batcher(1)
+    _mark(f"closed loop [inflight=1]: {clients} clients x {duration:.0f}s")
+    closed_serial = _serve_closed_loop(serial, metrics, req, clients,
+                                       duration)
+    serial_value = closed_serial["rows_per_sec"] / engine.n_chips
+    _mark(f"closed loop [inflight=1]: {serial_value:.0f} img/s/chip "
+          f"(p99 {closed_serial['latency_ms']['p99']} ms)")
+    _mark(f"open loop [inflight=1] qps={low_qps:g}")
+    _, open_serial = _serve_open_loop(serial, metrics, req, low_qps,
+                                      duration, max_wait_us)
+    serial.stop()
 
-    _mark(f"closed loop: {clients} clients x {duration:.0f}s")
-    metrics.reset()
-    stop_at = time.monotonic() + duration
-    threads = [threading.Thread(target=client, args=(stop_at,),
-                                daemon=True) for _ in range(clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    if client_errors:
-        raise RuntimeError(
-            f"{len(client_errors)} of {clients} closed-loop clients "
-            "died; the capacity headline would be measured against a "
-            "degraded pool") from client_errors[0]
-    closed = metrics.snapshot()
+    # Phase 2 — the pipelined window: the headline capacity and the
+    # full QPS sweep.
+    piped = make_batcher(pipelined)
+    _mark(f"closed loop [inflight={piped.max_inflight}]: "
+          f"{clients} clients x {duration:.0f}s")
+    closed = _serve_closed_loop(piped, metrics, req, clients, duration)
     value = closed["rows_per_sec"] / engine.n_chips
-    _mark(f"closed loop: {value:.0f} img/s/chip "
-          f"(p99 {closed['latency_ms']['p99']} ms)")
+    speedup = value / max(serial_value, 1e-9)
+    _mark(f"closed loop [inflight={piped.max_inflight}]: {value:.0f} "
+          f"img/s/chip (p99 {closed['latency_ms']['p99']} ms, "
+          f"{speedup:.2f}x serial)")
 
-    # Open loop: Poisson arrivals at each target QPS. Submissions don't
-    # wait for results (metrics record latency at completion), so queue
-    # growth and backpressure rejections are visible exactly when the
-    # target exceeds capacity.
     table = []
-    arrivals = random.Random(0)
     for qps in qps_sweep:
-        metrics.reset()
-        t_end = time.monotonic() + duration
-        next_t = time.monotonic()
-        submitted = 0
-        while next_t < t_end:
-            now = time.monotonic()
-            if next_t > now:
-                time.sleep(next_t - now)
-            try:
-                batcher.submit(req)
-                submitted += 1
-            except Rejected:
-                pass                # recorded by metrics
-            next_t += arrivals.expovariate(qps)
-        while batcher.pending_rows():
-            time.sleep(0.005)
-        time.sleep(max_wait_us / 1e6 + 0.05)   # let the last batch land
-        snap = metrics.snapshot()
+        submitted, snap = _serve_open_loop(piped, metrics, req, qps,
+                                           duration, max_wait_us)
         table.append({
             "qps_target": qps,
             "qps_submitted": round(submitted / duration, 1),
@@ -828,17 +944,21 @@ def _serve(args) -> int:
             "mean_rows_per_batch": snap["mean_rows_per_batch"],
             "batch_occupancy": snap["batch_occupancy"],
             "rejected_requests": snap["rejected_requests"],
+            "inflight_mean": snap["inflight_mean"],
+            "inflight_max": snap["inflight_max"],
         })
         _mark(f"open loop qps={qps:g}: p50="
               f"{snap['latency_ms']['p50']} ms, "
               f"{snap['rejected_requests']} rejected")
-    batcher.stop()
+    piped.stop()
 
     recompiles = engine.compile_events() - steady_from
     if recompiles:
         _mark(f"WARNING: {recompiles} compile events after warmup — "
               "steady state was supposed to be shape-stable")
-    print(json.dumps({
+    open_piped_low = next(r for r in table
+                          if r["qps_target"] == low_qps)
+    record = {
         "metric": "serve_images_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "images/sec/chip",
@@ -855,6 +975,7 @@ def _serve(args) -> int:
             "max_batch": engine.max_batch,
             "max_wait_us": max_wait_us,
             "queue_depth": queue_depth,
+            "max_inflight": piped.max_inflight,
             "rows_per_request": rows,
             "clients": clients,
             "duration_s": duration,
@@ -863,8 +984,39 @@ def _serve(args) -> int:
             "recompiles_after_warmup": recompiles,
             "closed_loop": closed,
             "qps_sweep": table,
+            # The measured overlap win (ISSUE 2 acceptance): pipelined
+            # capacity over the serial chain, and sub-capacity open-loop
+            # latency at both depths — pipelining must buy throughput
+            # without costing the lightly-loaded p99.
+            "inflight_comparison": {
+                "serial_img_s_chip": round(serial_value, 1),
+                "pipelined_img_s_chip": round(value, 1),
+                "speedup": round(speedup, 3),
+                "closed_loop_serial": closed_serial,
+                "open_loop_qps": low_qps,
+                "open_loop_serial_latency_ms": open_serial["latency_ms"],
+                "open_loop_pipelined_latency_ms":
+                    open_piped_low["latency_ms"],
+            },
         },
-    }))
+    }
+    print(json.dumps(record))
+    if not args.no_artifact:
+        # Best-effort: the record is already on stdout; an unwritable
+        # DEFAULT dir (no --artifact-dir given, so never pre-validated —
+        # e.g. a read-only checkout) must not turn a completed run into
+        # a nonzero exit.
+        artifact_dir = args.artifact_dir or os.path.dirname(
+            os.path.abspath(__file__))
+        try:
+            path = _next_serve_artifact(artifact_dir)
+            with open(path, "w") as f:
+                json.dump(record, f, indent=1)
+                f.write("\n")
+            _mark(f"artifact: {path}")
+        except OSError as e:
+            _mark(f"WARNING: artifact not written ({e}); the record "
+                  "above is the only copy")
     return 0
 
 

@@ -110,6 +110,13 @@ class Config:
     serve_max_batch: int = 512
     serve_max_wait_us: int = 1000
     serve_queue_depth: int = 4096
+    # serving dispatch pipelining: max dispatched-but-unfetched batches
+    # the batcher keeps in flight, so batch k's device compute overlaps
+    # batch k+1's host staging and batch k-1's result fan-out — the
+    # trainer's max_inflight discipline ported to serving. None = auto
+    # (1 on CPU, where staging and compute share the same cores; a small
+    # window on accelerators). 1 = the fully serial chain.
+    serve_max_inflight: Optional[int] = None
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -212,6 +219,10 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--serve-queue-depth", type=int, default=None,
                    help="[serving] backpressure watermark in pending "
                         "rows; beyond it requests are rejected (503)")
+    p.add_argument("--serve-max-inflight", type=int, default=None,
+                   help="[serving] max dispatched-but-unfetched batches "
+                        "kept in flight (pipelined dispatch; default: "
+                        "1 on cpu, 4 on accelerators)")
     p.add_argument("--no-flat-optimizer", dest="flat_optimizer",
                    action="store_false", default=None,
                    help="per-leaf optimizer update instead of the fused "

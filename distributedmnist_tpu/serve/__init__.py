@@ -1,9 +1,12 @@
 """Batched inference serving (ISSUE 1): the forward-only half of the
 north star's "serves heavy traffic from millions of users".
 
-- engine.py   bucketed, jitted, donated forward step over the 'data' mesh
-- batcher.py  dynamic micro-batcher with bounded-queue backpressure
-- metrics.py  latency percentiles / occupancy / qps, JSON-line records
+- engine.py   bucketed, jitted, donated forward step over the 'data' mesh,
+              split into dispatch()/fetch() around the async device queue
+- batcher.py  dynamic micro-batcher pipelined through a bounded in-flight
+              window, with bounded-queue backpressure
+- metrics.py  latency percentiles / occupancy / qps / pipeline depth and
+              staging-vs-fetch split, JSON-line records
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -12,11 +15,15 @@ parent must not import jax.
 _EXPORTS = {
     "InferenceEngine": ("distributedmnist_tpu.serve.engine",
                         "InferenceEngine"),
+    "InferenceHandle": ("distributedmnist_tpu.serve.engine",
+                        "InferenceHandle"),
     "build_engine": ("distributedmnist_tpu.serve.engine", "build_engine"),
     "make_buckets": ("distributedmnist_tpu.serve.engine", "make_buckets"),
     "DynamicBatcher": ("distributedmnist_tpu.serve.batcher",
                        "DynamicBatcher"),
     "Rejected": ("distributedmnist_tpu.serve.batcher", "Rejected"),
+    "resolve_max_inflight": ("distributedmnist_tpu.serve.batcher",
+                             "resolve_max_inflight"),
     "ServeMetrics": ("distributedmnist_tpu.serve.metrics", "ServeMetrics"),
 }
 

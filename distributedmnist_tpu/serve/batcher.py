@@ -1,16 +1,25 @@
 """Dynamic micro-batcher: coalesce concurrent requests into engine-sized
-batches, with bounded-queue backpressure.
+batches, pipelined through a bounded in-flight window, with bounded-queue
+backpressure.
 
 A single MNIST forward is ~microseconds of device time; serving requests
 one-at-a-time would be dispatch-bound exactly the way unfused training
 steps were (SURVEY.md §7.3). The batcher holds a thread-safe queue of
-pending requests and a single dispatch thread that coalesces whatever is
+pending requests and a **dispatch thread** that coalesces whatever is
 waiting — up to `max_batch` rows or `max_wait_us` after the oldest
-request arrived, whichever comes first — into one engine.infer() call
-(which pads to the covering bucket), then fans the sliced results back
-out to per-request futures. Latency-throughput tradeoff in two knobs:
+request arrived, whichever comes first — into one engine.dispatch() call
+(which pads into a pooled staging buffer and enqueues the jitted
+forward without fetching). A **completion thread** fetches results in
+dispatch order (engine.fetch()) and fans the sliced rows back out to
+per-request futures. Latency-throughput tradeoff in three knobs:
 `max_wait_us` bounds the queueing delay a lone request can suffer;
-`max_batch` bounds how much traffic one dispatch can absorb.
+`max_batch` bounds how much traffic one dispatch can absorb;
+`max_inflight` bounds how many dispatched-but-unfetched batches may
+overlap — batch k's device compute runs while batch k+1 stages on the
+host and batch k-1's results fan out, the trainer's bounded async
+window (trainer.py max_inflight) ported to serving. At max_inflight=1
+the pipeline degenerates to the fully serial chain (the honest baseline
+bench.py serve compares against).
 
 Backpressure: admission is bounded by `queue_depth` PENDING rows. Beyond
 the watermark submit() raises Rejected (HTTP 503 semantics — serve.py
@@ -23,14 +32,13 @@ argument — PAPERS.md).
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional
-
-import numpy as np
 
 
 class Rejected(RuntimeError):
@@ -39,25 +47,44 @@ class Rejected(RuntimeError):
     status = 503
 
 
+def resolve_max_inflight(value: Optional[int], platform: str) -> int:
+    """The serve_max_inflight auto rule, mirroring the trainer's: an
+    explicit value wins; None means 1 on CPU (host staging and "device"
+    compute share the same cores, so overlap buys little and depth only
+    adds latency) and a small pipeline window on accelerators (serving
+    forwards carry no collectives, so the trainer's CPU-deadlock concern
+    does not apply — the conservative CPU default is about latency, not
+    correctness)."""
+    if value is not None:
+        if value < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {value}")
+        return value
+    return 1 if platform == "cpu" else 4
+
+
 @dataclass
 class _Request:
-    x: np.ndarray                 # (n, 28, 28, 1) uint8
+    x: "object"                   # (n, 28, 28, 1) uint8 ndarray
     n: int
     t_enqueue: float              # time.monotonic()
     future: Future = field(default_factory=Future)
 
 
 class DynamicBatcher:
-    """Single dispatch thread over a bounded request queue.
+    """Dispatch + completion threads over a bounded request queue.
 
-    start()/stop() manage the thread; submit(x) -> Future resolving to
-    the request's (n, 10) logits. All engine calls happen on the one
-    dispatch thread, so the engine itself needs no locking.
+    start()/stop() manage the threads; submit(x) -> Future resolving to
+    the request's (n, 10) logits. All engine.dispatch() calls happen on
+    the one dispatch thread and all engine.fetch() calls on the one
+    completion thread, in dispatch order — so results can never reorder
+    across batches and the engine needs no locking beyond its staging
+    pool.
     """
 
     def __init__(self, engine, max_batch: Optional[int] = None,
                  max_wait_us: int = 1000,
-                 queue_depth: int = 4096, metrics=None):
+                 queue_depth: int = 4096, metrics=None,
+                 max_inflight: Optional[int] = None):
         self.engine = engine
         self.max_batch = min(max_batch or engine.max_batch,
                              engine.buckets[-1])
@@ -66,11 +93,22 @@ class DynamicBatcher:
         self.max_wait_s = max_wait_us / 1e6
         self.queue_depth = queue_depth
         self.metrics = metrics
+        self.max_inflight = resolve_max_inflight(
+            max_inflight, getattr(engine, "platform", "cpu"))
         self._q: deque[_Request] = deque()
         self._rows = 0                   # pending rows, watermark basis
         self._cond = threading.Condition()
         self._stop = False
-        self._thread: Optional[threading.Thread] = None
+        # The in-flight window: a slot is held from the moment a batch
+        # is popped off the queue until its results have fanned out, so
+        # dispatched-but-unresolved batches never exceed max_inflight.
+        self._slots = threading.Semaphore(self.max_inflight)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # dispatch -> completion, FIFO; None is the shutdown sentinel.
+        self._handles: queue.SimpleQueue = queue.SimpleQueue()
+        self._dispatcher: Optional[threading.Thread] = None
+        self._completer: Optional[threading.Thread] = None
 
     # -- client side -------------------------------------------------------
 
@@ -103,19 +141,43 @@ class DynamicBatcher:
         with self._cond:
             return self._rows
 
+    def inflight_batches(self) -> int:
+        """Batches popped off the queue whose futures have not yet all
+        resolved (<= max_inflight by construction — the pipeline-depth
+        invariant tests assert). pending_rows()==0 AND
+        inflight_batches()==0 together mean fully drained."""
+        with self._inflight_lock:
+            return self._inflight
+
     # -- dispatch side -----------------------------------------------------
 
     def start(self) -> "DynamicBatcher":
-        if self._thread is not None:
+        if self._dispatcher is not None:
             raise RuntimeError("batcher already started")
-        self._thread = threading.Thread(target=self._loop,
-                                        name="serve-batcher", daemon=True)
-        self._thread.start()
+        if self._stop:
+            # A stopped batcher's threads may still be winding down on
+            # the shared handle queue; a restart would race them (and
+            # submit() is permanently closed anyway). One-shot lifecycle.
+            raise RuntimeError(
+                "batcher is stopped; construct a new one instead of "
+                "restarting")
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatch", daemon=True)
+        self._completer = threading.Thread(
+            target=self._completion_loop, name="serve-complete",
+            daemon=True)
+        self._dispatcher.start()
+        self._completer.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
-        """Stop the dispatch thread; drain=True serves what is already
-        queued first, drain=False fails pending futures."""
+        """Stop the pipeline; drain=True serves what is already queued
+        AND fetches every in-flight batch before returning (every
+        accepted future resolves), drain=False fails still-queued
+        futures immediately — in-flight batches are already on the
+        device, so their futures still resolve when their fetch lands
+        (the threads are daemons; a wedged fetch is abandoned after a
+        short join rather than holding stop() hostage)."""
         with self._cond:
             self._stop = True
             if not drain:
@@ -125,9 +187,11 @@ class DynamicBatcher:
                     req.future.set_exception(
                         RuntimeError("batcher stopped"))
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            self._thread = None
+        timeout = 30 if drain else 1
+        for t in (self._dispatcher, self._completer):
+            if t is not None:
+                t.join(timeout=timeout)
+        self._dispatcher = self._completer = None
 
     def _take_batch(self) -> list[_Request]:
         """Block until there is work, then coalesce: wait until max_batch
@@ -152,21 +216,61 @@ class DynamicBatcher:
                 taken += req.n
                 batch.append(req)
             self._rows -= taken
+            if batch:
+                # Claim in-flight BEFORE the queue lock drops: an
+                # observer that sees pending_rows()==0 is then
+                # guaranteed to see this batch in inflight_batches(),
+                # so "pending==0 and inflight==0" really means drained
+                # (the bench's open-loop drain predicate).
+                with self._inflight_lock:
+                    self._inflight += 1
             return batch
 
-    def _loop(self) -> None:
+    def _dispatch_loop(self) -> None:
         while True:
+            # Acquire the window slot BEFORE coalescing: while the
+            # window is full, arriving requests keep accumulating toward
+            # a fuller batch instead of being split across dispatches.
+            self._slots.acquire()
             batch = self._take_batch()
             if not batch:
+                self._slots.release()
+                self._handles.put(None)      # completion shutdown
                 return
-            rows = sum(r.n for r in batch)
+            t0 = time.monotonic()
             try:
-                x = (batch[0].x if len(batch) == 1
-                     else np.concatenate([r.x for r in batch]))
-                logits = self.engine.infer(x)
+                handle = self.engine.dispatch([r.x for r in batch])
+            except Exception as e:   # fail the batch, keep serving
+                # failures fan out BEFORE the batch leaves the in-flight
+                # count — same drain invariant as the completion loop
+                for r in batch:
+                    r.future.set_exception(e)
+                with self._inflight_lock:
+                    self._inflight -= 1
+                self._slots.release()
+                continue
+            with self._inflight_lock:
+                depth = self._inflight
+            if self.metrics is not None:
+                self.metrics.record_dispatch(time.monotonic() - t0,
+                                             inflight=depth)
+            self._handles.put((batch, handle))
+
+    def _completion_loop(self) -> None:
+        while True:
+            item = self._handles.get()
+            if item is None:
+                return
+            batch, handle = item
+            t0 = time.monotonic()
+            try:
+                logits = self.engine.fetch(handle)
             except Exception as e:   # fan the failure out, keep serving
                 for r in batch:
                     r.future.set_exception(e)
+                with self._inflight_lock:
+                    self._inflight -= 1
+                self._slots.release()
                 continue
             t_done = time.monotonic()
             off = 0
@@ -174,9 +278,19 @@ class DynamicBatcher:
                 r.future.set_result(logits[off:off + r.n])
                 off += r.n
             if self.metrics is not None:
+                rows = sum(r.n for r in batch)
+                self.metrics.record_fetch(t_done - t0)
                 self.metrics.record_batch(
-                    rows=rows, bucket=self.engine.bucket_for(rows),
+                    rows=rows, bucket=handle.bucket,
                     queue_depth=self.pending_rows())
                 for r in batch:
                     self.metrics.record_latency(t_done - r.t_enqueue,
                                                 rows=r.n)
+            # A batch leaves the in-flight count (and frees its window
+            # slot) only AFTER its futures resolved and its metrics
+            # landed: inflight_batches()==0 with an empty queue then
+            # proves every accepted request is fully served — the drain
+            # invariant the bench and stop() rely on.
+            with self._inflight_lock:
+                self._inflight -= 1
+            self._slots.release()

@@ -21,8 +21,10 @@ process restart a disk hit instead of a recompile.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
-from typing import Optional, Sequence
+import threading
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
@@ -33,6 +35,19 @@ log = logging.getLogger("distributedmnist_tpu")
 
 IMAGE_SHAPE = (28, 28, 1)
 IMAGE_SIZE = 28 * 28
+
+
+@dataclasses.dataclass
+class InferenceHandle:
+    """A dispatched-but-unfetched forward: the device-side logits plus
+    what fetch() needs to slice the real rows back out and recycle the
+    host staging buffer. Produced by InferenceEngine.dispatch(), consumed
+    exactly once by InferenceEngine.fetch()."""
+
+    logits: Any                   # device array, (bucket, 10)
+    n: int                        # real rows (the rest is padding)
+    bucket: int
+    staging: Optional[np.ndarray]  # recycled on fetch; None after
 
 
 def make_buckets(max_batch: int, n_chips: int,
@@ -74,6 +89,7 @@ class InferenceEngine:
         self._compiles = CompileCounter.instance()
         self.mesh = mesh
         self.n_chips = int(np.prod(mesh.devices.shape))
+        self.platform = mesh.devices.flat[0].platform
         self.dtype = dtype if dtype is not None else jnp.float32
         self.max_batch = max_batch
         self.buckets = (tuple(sorted(set(buckets))) if buckets
@@ -98,6 +114,18 @@ class InferenceEngine:
         # cast, so XLA may reuse it (a no-op with a warning on backends
         # without donation, e.g. CPU — harmless).
         self._forward = jax.jit(forward, donate_argnums=1)
+        # Host staging buffers, one free-list per bucket: dispatch() pads
+        # requests into a pooled (bucket, 28, 28, 1) uint8 array instead
+        # of allocating np.zeros + np.concatenate per call; fetch()
+        # returns the buffer to the pool. Pool size is therefore bounded
+        # by the caller's dispatched-but-unfetched window (the batcher's
+        # max_inflight), never by traffic volume. A buffer is only
+        # recycled AFTER its batch's device->host value fetch, so reuse
+        # can never race the device still reading it, even if device_put
+        # were zero-copy on some backend.
+        self._staging_pool: dict[int, list[np.ndarray]] = {
+            b: [] for b in self.buckets}
+        self._staging_lock = threading.Lock()
 
     # -- bucketing ---------------------------------------------------------
 
@@ -125,25 +153,69 @@ class InferenceEngine:
                 f"got shape {x.shape}")
         return x
 
+    # -- staging pool ------------------------------------------------------
+
+    def _staging_take(self, bucket: int) -> np.ndarray:
+        with self._staging_lock:
+            pool = self._staging_pool[bucket]
+            if pool:
+                return pool.pop()
+        return np.empty((bucket, *IMAGE_SHAPE), np.uint8)
+
+    def staging_buffers(self) -> dict[int, int]:
+        """Per-bucket free-list sizes (tests assert the pool stays
+        bounded by the in-flight window, not traffic)."""
+        with self._staging_lock:
+            return {b: len(p) for b, p in self._staging_pool.items()}
+
     # -- inference ---------------------------------------------------------
+
+    def dispatch(self, x) -> InferenceHandle:
+        """Phase 1 of infer(): pad `x` — one uint8 image array or a list
+        of them (coalesced requests; staged directly, no intermediate
+        concatenate) — into a pooled staging buffer, device_put, enqueue
+        the jitted forward, and return WITHOUT fetching. JAX dispatch is
+        async, so the device computes this batch while the caller stages
+        the next one — the trainer's bounded in-flight overlap, ported
+        to serving."""
+        import jax
+
+        parts = ([self._as_images(p) for p in x]
+                 if isinstance(x, (list, tuple))
+                 else [self._as_images(x)])
+        n = sum(p.shape[0] for p in parts)
+        b = self.bucket_for(n)
+        staging = self._staging_take(b)
+        off = 0
+        for p in parts:
+            staging[off:off + p.shape[0]] = p
+            off += p.shape[0]
+        if n < b:
+            staging[n:] = 0
+        x_dev = jax.device_put(staging, self._x_sharding)
+        logits = self._forward(self.params, x_dev)
+        return InferenceHandle(logits=logits, n=n, bucket=b,
+                               staging=staging)
+
+    def fetch(self, handle: InferenceHandle) -> np.ndarray:
+        """Phase 2: the device->host VALUE fetch (blocks until the
+        batch's compute is done — the result bytes a client would be
+        sent, the StepTimer.barrier argument) plus the slice back to the
+        real rows. Recycles the handle's staging buffer; one-shot."""
+        if handle.staging is None:
+            raise RuntimeError("handle already fetched")
+        out = np.asarray(handle.logits)[:handle.n]
+        with self._staging_lock:
+            self._staging_pool[handle.bucket].append(handle.staging)
+        handle.staging = None
+        return out
 
     def infer(self, x) -> np.ndarray:
         """Logits (n, 10) for n uint8 images; pad-and-slice through the
-        covering bucket. The np.asarray fetch is a device->host VALUE
-        fetch — the result bytes a client would be sent — so per-request
-        latency measured around infer() is honest end-to-end time (the
-        StepTimer.barrier argument)."""
-        import jax
-
-        x = self._as_images(x)
-        n = x.shape[0]
-        b = self.bucket_for(n)
-        if n < b:
-            x = np.concatenate(
-                [x, np.zeros((b - n, *IMAGE_SHAPE), np.uint8)])
-        x_dev = jax.device_put(x, self._x_sharding)
-        logits = self._forward(self.params, x_dev)
-        return np.asarray(logits)[:n]
+        covering bucket. Synchronous composition of dispatch() + fetch(),
+        so per-request latency measured around infer() is honest
+        end-to-end time."""
+        return self.fetch(self.dispatch(x))
 
     def warmup(self) -> int:
         """Compile (or load from the persistent cache) every bucket's

@@ -42,6 +42,16 @@ class ServeMetrics:
             self._occupancy: dict[int, list] = {}  # bucket -> [batches,
             self._depth_sum = 0                    #            rows]
             self._depth_max = 0
+            # pipeline split (ISSUE 2): host staging time vs blocking
+            # device->host fetch time per batch, and the in-flight depth
+            # gauge — together they say whether the bounded window is
+            # actually overlapping (staging+fetch >> batch period) or
+            # idling at depth 1.
+            self._staging_s: deque = deque(maxlen=self._max_samples)
+            self._fetch_s: deque = deque(maxlen=self._max_samples)
+            self._dispatches = 0
+            self._inflight_sum = 0
+            self._inflight_max = 0
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -50,6 +60,21 @@ class ServeMetrics:
             self._lat_s.append(seconds)
             self._requests += 1
             self._rows += rows
+
+    def record_dispatch(self, staging_seconds: float,
+                        inflight: int = 1) -> None:
+        """One batch dispatched: host staging time (pad + device_put +
+        enqueue, no fetch) and the pipeline depth right after dispatch."""
+        with self._lock:
+            self._staging_s.append(staging_seconds)
+            self._dispatches += 1
+            self._inflight_sum += inflight
+            self._inflight_max = max(self._inflight_max, inflight)
+
+    def record_fetch(self, seconds: float) -> None:
+        """One batch's blocking device->host value fetch completed."""
+        with self._lock:
+            self._fetch_s.append(seconds)
 
     def record_batch(self, rows: int, bucket: int,
                      queue_depth: int) -> None:
@@ -95,6 +120,17 @@ class ServeMetrics:
                 "queue_depth_max": self._depth_max,
                 "rejected_requests": self._rejected_requests,
                 "rejected_rows": self._rejected_rows,
+                "staging_ms": {
+                    k: (round(v * 1e3, 3) if v is not None else None)
+                    for k, v in percentiles(
+                        list(self._staging_s)).items()},
+                "fetch_ms": {
+                    k: (round(v * 1e3, 3) if v is not None else None)
+                    for k, v in percentiles(list(self._fetch_s)).items()},
+                "inflight_mean": (
+                    round(self._inflight_sum / self._dispatches, 2)
+                    if self._dispatches else None),
+                "inflight_max": self._inflight_max,
             }
 
     def record(self) -> dict:

@@ -28,7 +28,10 @@ shut the server down cleanly and print a final summary line.
 Model/params come from Config: --checkpoint-dir restores trained params
 (the usual serving case); otherwise params are fresh-init (load tests).
 Batching knobs: --serve-max-batch, --serve-max-wait-us,
---serve-queue-depth (config.py).
+--serve-queue-depth, --serve-max-inflight (config.py). --request-timeout
+bounds how long an HTTP client thread may wait on its future before a
+504 — a wedged dispatch pipeline must shed its waiters, not hold
+ThreadingHTTPServer threads forever.
 """
 
 from __future__ import annotations
@@ -70,7 +73,7 @@ def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
 
 
 def _http_serve(batcher, metrics, engine, port: int,
-                metrics_every: float) -> dict:
+                metrics_every: float, request_timeout: float) -> dict:
     import concurrent.futures
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -120,13 +123,18 @@ def _http_serve(batcher, metrics, engine, port: int,
             raw = self.rfile.read(length)
             x = np.frombuffer(raw, np.uint8).reshape(-1, IMAGE_BYTES)
             try:
-                logits = batcher.submit(x).result(timeout=60)
+                # Bounded wait: if the dispatch pipeline wedges, this
+                # handler thread must come back (504) rather than be
+                # held forever — ThreadingHTTPServer has no thread cap,
+                # so unbounded waiters pile up until exhaustion.
+                logits = batcher.submit(x).result(timeout=request_timeout)
             except Rejected:
                 self._send(503, {"error": "overloaded; retry"},
                            extra={"Retry-After": "1"})
                 return
             except concurrent.futures.TimeoutError:
-                self._send(504, {"error": "inference timed out"})
+                self._send(504, {"error": "inference timed out after "
+                                          f"{request_timeout:g}s"})
                 return
             except Exception as e:   # engine fan-out / batcher stopped:
                 # an HTTP error beats a dropped keep-alive connection
@@ -180,9 +188,17 @@ def main(argv=None) -> int:
                         "and exit (default mode, N=256)")
     p.add_argument("--metrics-every", type=float, default=10.0,
                    help="seconds between serve_stats heartbeat lines")
+    p.add_argument("--request-timeout", type=float, default=60.0,
+                   help="seconds an HTTP request may wait on its result "
+                        "before a 504 (bounds handler-thread lifetime "
+                        "when the pipeline wedges)")
     args = p.parse_args(argv)
     if args.port is not None and args.selftest is not None:
         p.error("--port and --selftest are mutually exclusive")
+    if args.request_timeout <= 0:
+        p.error("--request-timeout must be > 0")
+    if args.serve_max_inflight is not None and args.serve_max_inflight < 1:
+        p.error("--serve-max-inflight must be >= 1")
     cfg = config_lib.from_args(args)
 
     from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
@@ -198,14 +214,18 @@ def main(argv=None) -> int:
     batcher = DynamicBatcher(engine, max_batch=cfg.serve_max_batch,
                              max_wait_us=cfg.serve_max_wait_us,
                              queue_depth=cfg.serve_queue_depth,
+                             max_inflight=cfg.serve_max_inflight,
                              metrics=metrics).start()
+    logging.getLogger("distributedmnist_tpu").info(
+        "dispatch pipeline depth: %d", batcher.max_inflight)
     try:
         if args.port is None:
             summary = _selftest(batcher, metrics, args.selftest or 256,
                                 engine.max_batch)
         else:
             summary = _http_serve(batcher, metrics, engine, args.port,
-                                  args.metrics_every)
+                                  args.metrics_every,
+                                  args.request_timeout)
     finally:
         batcher.stop()
     print(json.dumps(summary), flush=True)

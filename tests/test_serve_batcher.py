@@ -1,24 +1,34 @@
 """serve/batcher.py: coalescing under max_wait_us, max_batch-triggered
 flush, per-request fan-out correctness, bounded-queue backpressure
-(Rejected at the watermark), and metrics recording — all against a stub
-engine with a controllable infer(), so the batching logic is tested in
-isolation from jax."""
+(Rejected at the watermark), metrics recording, and the ISSUE 2 pipeline
+invariants (in-flight window bound, drain semantics, fan-out under
+overlap) — all against a stub engine with a controllable
+dispatch()/fetch(), so the batching logic is tested in isolation from
+jax."""
 
 import threading
 import time
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
 
-from distributedmnist_tpu.serve import DynamicBatcher, Rejected, ServeMetrics
+from distributedmnist_tpu.serve import (DynamicBatcher, Rejected,
+                                        ServeMetrics, resolve_max_inflight)
 from distributedmnist_tpu.serve.engine import InferenceEngine
 
 
 class StubEngine:
-    """Engine-shaped test double. infer() returns each row's first 10
+    """Engine-shaped test double implementing the two-phase
+    dispatch()/fetch() pipeline API. fetch() returns each row's first 10
     pixel values as float 'logits', so a request's result identifies
     exactly which input rows it was served from. An optional gate Event
-    makes dispatch block deterministically (backpressure tests)."""
+    makes fetch() block deterministically — the stand-in for device
+    compute still running — so tests control exactly when the pipeline
+    drains. Dispatched-but-unfetched depth is tracked so tests can
+    assert the batcher's bounded window from the engine's side."""
+
+    platform = "cpu"
 
     def __init__(self, max_batch=16, n_chips=4, gate=None):
         self.max_batch = max_batch
@@ -27,8 +37,11 @@ class StubEngine:
         while self.buckets[-1] < max_batch:
             self.buckets += (self.buckets[-1] * 2,)
         self.gate = gate
-        self.calls = []            # row counts per infer() call
-        self.in_call = threading.Event()
+        self.calls = []            # row counts per dispatch() call
+        self.in_call = threading.Event()  # set on every dispatch()
+        self.inflight = 0
+        self.inflight_max = 0
+        self._lock = threading.Lock()
 
     _as_images = staticmethod(InferenceEngine._as_images)
 
@@ -38,12 +51,28 @@ class StubEngine:
                 return b
         raise ValueError(n)
 
-    def infer(self, x):
+    def dispatch(self, x):
+        parts = ([self._as_images(p) for p in x]
+                 if isinstance(x, (list, tuple))
+                 else [self._as_images(x)])
+        x = np.concatenate(parts) if len(parts) > 1 else parts[0]
         self.calls.append(x.shape[0])
+        with self._lock:
+            self.inflight += 1
+            self.inflight_max = max(self.inflight_max, self.inflight)
         self.in_call.set()
+        return SimpleNamespace(x=x, n=x.shape[0],
+                               bucket=self.bucket_for(x.shape[0]))
+
+    def fetch(self, handle):
         if self.gate is not None:
             assert self.gate.wait(timeout=30)
-        return x.reshape(x.shape[0], -1)[:, :10].astype(np.float32)
+        with self._lock:
+            self.inflight -= 1
+        return handle.x.reshape(handle.n, -1)[:, :10].astype(np.float32)
+
+    def infer(self, x):
+        return self.fetch(self.dispatch(x))
 
 
 def _rows(rng, n):
@@ -167,6 +196,115 @@ def test_stop_without_drain_fails_pending_futures(rng):
         pending.result(timeout=10)
     with pytest.raises(RuntimeError, match="stopped"):
         b.submit(_rows(rng, 1))
+
+
+def test_resolve_max_inflight_rules():
+    """Explicit wins; auto is 1 on CPU (no overlap to buy) and a small
+    pipeline window on accelerators; <1 is a usage error."""
+    assert resolve_max_inflight(3, "cpu") == 3
+    assert resolve_max_inflight(1, "tpu") == 1
+    assert resolve_max_inflight(None, "cpu") == 1
+    assert resolve_max_inflight(None, "tpu") > 1
+    assert resolve_max_inflight(None, "gpu") > 1
+    with pytest.raises(ValueError, match="max_inflight"):
+        resolve_max_inflight(0, "cpu")
+
+
+def test_inflight_depth_never_exceeds_window(rng):
+    """The pipeline-depth invariant: with fetch wedged, the dispatch
+    thread may run ahead by exactly max_inflight batches — never more —
+    and the engine-side dispatched-but-unfetched counter proves it."""
+    eng = StubEngine(max_batch=4)
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=256,
+                       max_inflight=2).start()
+    try:
+        futs = []
+        # fill the window: two dispatched-but-unfetched batches
+        for _ in range(2):
+            eng.in_call.clear()
+            futs.append(b.submit(_rows(rng, 4)))
+            assert eng.in_call.wait(timeout=10)
+        assert b.inflight_batches() == 2
+        # more work queues up but must NOT dispatch past the window
+        futs += [b.submit(_rows(rng, 4)) for _ in range(4)]
+        time.sleep(0.2)
+        assert eng.inflight == 2 and len(eng.calls) == 2, (
+            f"dispatch ran past the window: {eng.calls}")
+        gate.set()
+        for f in futs:
+            assert f.result(timeout=10).shape == (4, 10)
+    finally:
+        b.stop()
+    assert eng.inflight_max == 2, (
+        f"window of 2 was exceeded (peak {eng.inflight_max})")
+    assert b.inflight_batches() == 0
+
+
+def test_stop_drain_resolves_inflight_and_queued(rng):
+    """stop(drain=True) with the window full AND requests still queued:
+    every accepted future resolves with its own rows' results."""
+    eng = StubEngine(max_batch=4)
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=256,
+                       max_inflight=2).start()
+    xs = []
+    futs = []
+    for _ in range(2):          # two in-flight batches, wedged at fetch
+        eng.in_call.clear()
+        xs.append(_rows(rng, 4))
+        futs.append(b.submit(xs[-1]))
+        assert eng.in_call.wait(timeout=10)
+    for _ in range(3):          # still queued behind the full window
+        xs.append(_rows(rng, 2))
+        futs.append(b.submit(xs[-1]))
+    threading.Timer(0.2, gate.set).start()
+    b.stop(drain=True)
+    for x, f in zip(xs, futs):
+        want = x.reshape(x.shape[0], -1)[:, :10].astype(np.float32)
+        np.testing.assert_array_equal(f.result(timeout=0), want)
+
+
+def test_fan_out_correct_under_pipelined_overlap(rng):
+    """Reordering pressure: a free-running window of 3 keeps dispatch,
+    fetch, and fan-out overlapping across many mixed-size requests; the
+    identity 'logits' prove every future resolves to exactly its own
+    rows, in order, despite the concurrency."""
+    eng = StubEngine(max_batch=16)
+    b = DynamicBatcher(eng, max_wait_us=200, queue_depth=4096,
+                       max_inflight=3).start()
+    try:
+        sizes = [int(rng.integers(1, 9)) for _ in range(60)]
+        xs = [_rows(rng, n) for n in sizes]
+        futs = [b.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            want = x.reshape(x.shape[0], -1)[:, :10].astype(np.float32)
+            np.testing.assert_array_equal(f.result(timeout=30), want)
+    finally:
+        b.stop()
+    assert eng.inflight_max <= 3
+
+
+def test_pipeline_metrics_split_and_depth_gauge(rng):
+    """The ISSUE 2 observability additions: staging_ms / fetch_ms
+    percentiles and the in-flight depth gauge are populated and the
+    gauge respects the window bound."""
+    metrics = ServeMetrics()
+    eng = StubEngine(max_batch=8)
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=256,
+                       max_inflight=2, metrics=metrics).start()
+    try:
+        for _ in range(6):
+            b.submit(_rows(rng, 2)).result(timeout=10)
+    finally:
+        b.stop()
+    snap = metrics.snapshot()
+    assert snap["staging_ms"]["p50"] is not None
+    assert snap["fetch_ms"]["p50"] is not None
+    assert 1 <= snap["inflight_max"] <= 2
+    assert snap["inflight_mean"] >= 1
 
 
 def test_metrics_record_occupancy_and_latency(rng):

@@ -30,13 +30,16 @@ def _run_cli(script, extra, timeout=600):
 
 SERVE_ARGS = ["--inline", "--model", "mlp", "--serve-duration", "0.5",
               "--serve-qps", "40", "--serve-clients", "2",
-              "--serve-max-batch", "16", "--serve-max-wait-us", "2000"]
+              "--serve-max-batch", "16", "--serve-max-wait-us", "2000",
+              "--no-artifact"]
 
 
 def test_bench_serve_contract():
     """`python bench.py serve` (the acceptance-criteria spelling)
-    completes a QPS sweep and emits the parseable record — including
-    p50/p95/p99, batch occupancy, and zero steady-state recompiles."""
+    completes the serial-vs-pipelined capacity phases and the QPS sweep
+    and emits the parseable record — including p50/p95/p99, batch
+    occupancy, the inflight comparison, and zero steady-state
+    recompiles."""
     out = _run_cli("bench.py", ["serve"] + SERVE_ARGS)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.splitlines() if l.strip()]
@@ -50,11 +53,13 @@ def test_bench_serve_contract():
     # steady state after bucket warmup must be recompile-free
     assert d["warmup_compile_events"] > 0
     assert d["recompiles_after_warmup"] == 0
+    assert d["max_inflight"] == 4          # the bench's pipelined default
     closed = d["closed_loop"]
     for q in ("p50", "p95", "p99"):
         assert closed["latency_ms"][q] is not None
     assert closed["batch_occupancy"], "no occupancy histogram"
     assert closed["rows_per_sec"] > 0
+    assert closed["inflight_max"] >= 1
     # the open-loop sweep ran and carries the latency-vs-throughput table
     assert len(d["qps_sweep"]) == 1
     point = d["qps_sweep"][0]
@@ -62,6 +67,39 @@ def test_bench_serve_contract():
     assert point["latency_ms"]["p99"] is not None
     assert point["img_s_chip"] > 0
     assert d["buckets"] == [8, 16]
+    # the serial-vs-pipelined comparison is measured, not claimed
+    cmp = d["inflight_comparison"]
+    assert cmp["serial_img_s_chip"] > 0
+    assert cmp["pipelined_img_s_chip"] > 0
+    assert cmp["speedup"] == pytest.approx(
+        cmp["pipelined_img_s_chip"] / cmp["serial_img_s_chip"], rel=0.01)
+    assert cmp["closed_loop_serial"]["inflight_max"] == 1
+    assert cmp["open_loop_serial_latency_ms"]["p99"] is not None
+    assert cmp["open_loop_pipelined_latency_ms"]["p99"] is not None
+
+
+@pytest.mark.slow
+def test_bench_serve_writes_artifact(tmp_path):
+    """The serve perf trajectory is machine-readable: a full (longer)
+    load run writes BENCH_serve_r01.json into --artifact-dir, its content
+    byte-identical in meaning to the stdout record, and a second run
+    picks the next round number instead of clobbering."""
+    args = ["serve", "--inline", "--model", "mlp",
+            "--serve-duration", "1.5", "--serve-qps", "40",
+            "--serve-clients", "4", "--serve-max-batch", "16",
+            "--serve-max-wait-us", "2000",
+            "--artifact-dir", str(tmp_path)]
+    out = _run_cli("bench.py", args)
+    assert out.returncode == 0, out.stderr[-2000:]
+    path = tmp_path / "BENCH_serve_r01.json"
+    assert path.exists(), list(tmp_path.iterdir())
+    rec = json.loads(out.stdout.strip())
+    art = json.loads(path.read_text())
+    assert art == rec
+    (tmp_path / "BENCH_serve_r07.json").write_text("{}")
+    out = _run_cli("bench.py", args)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert (tmp_path / "BENCH_serve_r08.json").exists()
 
 
 def test_bench_serve_rejects_training_flags():
@@ -82,6 +120,23 @@ def test_bench_training_modes_reject_serve_flags():
 
 def test_bench_positional_mode_conflict_rejected():
     out = _run_cli("bench.py", ["serve", "--mode", "smoke"], timeout=60)
+    assert out.returncode == 2
+
+
+def test_bench_serve_inflight_flag_validated():
+    out = _run_cli("bench.py", ["serve", "--serve-max-inflight", "0"],
+                   timeout=60)
+    assert out.returncode == 2
+    # serve-only flag rejected outside serve mode
+    out = _run_cli("bench.py", ["smoke", "--serve-max-inflight", "2"],
+                   timeout=60)
+    assert out.returncode == 2
+
+
+def test_serve_request_timeout_flag_validated():
+    out = _run_cli("serve.py", ["--request-timeout", "0"], timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("serve.py", ["--serve-max-inflight", "0"], timeout=60)
     assert out.returncode == 2
 
 

@@ -91,3 +91,57 @@ def test_warmup_is_idempotent(engine):
     events (in-memory jit cache hit — the restart case additionally goes
     through the persistent cache)."""
     assert engine.warmup() == 0
+
+
+def test_dispatch_fetch_composes_to_infer(engine, rng):
+    """The two-phase API (ISSUE 2): dispatch() returns a handle without
+    fetching; fetch() yields exactly what the synchronous infer() does —
+    including for a LIST of request parts, which must equal inference on
+    their concatenation (the batcher's coalesced-dispatch path)."""
+    parts = [rng.integers(0, 256, (n, 28, 28, 1)).astype(np.uint8)
+             for n in (3, 1, 5)]
+    h = engine.dispatch(parts)
+    assert h.n == 9 and h.bucket == 16
+    got = engine.fetch(h)
+    np.testing.assert_allclose(got, engine.infer(np.concatenate(parts)),
+                               rtol=1e-6, atol=1e-6)
+    with pytest.raises(RuntimeError, match="already fetched"):
+        engine.fetch(h)
+
+
+def test_staging_pool_bounded_by_inflight_window(engine, rng):
+    """Staging buffers recycle through a per-bucket free list: serial
+    traffic keeps at most one buffer per bucket alive, and overlapping
+    dispatches draw DISTINCT buffers (a shared one would let batch k+1's
+    padding race batch k's device_put)."""
+    for n in (1, 3, 9, 17, 2, 30):
+        engine.infer(rng.integers(0, 256, (n, 28, 28, 1)).astype(np.uint8))
+    assert all(v <= 1 for v in engine.staging_buffers().values())
+
+    x = rng.integers(0, 256, (2, 28, 28, 1)).astype(np.uint8)
+    h1, h2 = engine.dispatch(x), engine.dispatch(x)
+    assert h1.staging is not h2.staging
+    np.testing.assert_array_equal(engine.fetch(h1), engine.fetch(h2))
+    assert engine.staging_buffers()[h1.bucket] == 2   # both recycled
+
+
+def test_zero_recompiles_with_pipelining_on(engine, rng):
+    """The steady-state compile-stability contract must survive the
+    pipelined dispatch window: a mixed-size request stream pushed through
+    a DynamicBatcher at max_inflight=4 moves the compile counter by
+    exactly zero."""
+    from distributedmnist_tpu.serve import DynamicBatcher
+
+    before = engine.compile_events()
+    b = DynamicBatcher(engine, max_wait_us=200, queue_depth=4096,
+                       max_inflight=4).start()
+    try:
+        sizes = [1, 3, 7, 8, 9, 15, 16, 17, 30, 32, 5, 12, 27] * 3
+        futs = [(n, b.submit(rng.integers(0, 256, (n, 28, 28, 1))
+                             .astype(np.uint8))) for n in sizes]
+        for n, f in futs:
+            assert f.result(timeout=60).shape == (n, 10)
+    finally:
+        b.stop()
+    assert engine.compile_events() - before == 0, (
+        "pipelined serving recompiled despite bucketed shapes")
