@@ -160,6 +160,13 @@ def main(argv=None) -> int:
                    help="[serve] pipelined dispatch window for the "
                         "headline phase (default 4); the capacity phase "
                         "always also runs at 1 for the serial baseline")
+    p.add_argument("--swap-during-load", action="store_true", default=None,
+                   help="[serve] add a closed-loop phase with a REAL "
+                        "model roll mid-window: load + pre-warm a second "
+                        "version while clients hammer the live one, "
+                        "promote it atomically, and report swap-window "
+                        "p99 vs steady-state p99 plus the post-warm "
+                        "recompile count (must be 0)")
     p.add_argument("--artifact-dir", default=None,
                    help="[serve] directory for the BENCH_serve_r*.json "
                         "artifact (default: bench.py's own directory)")
@@ -184,6 +191,7 @@ def main(argv=None) -> int:
                    "--serve-max-wait-us": args.serve_max_wait_us,
                    "--serve-queue-depth": args.serve_queue_depth,
                    "--serve-max-inflight": args.serve_max_inflight,
+                   "--swap-during-load": args.swap_during_load,
                    "--artifact-dir": args.artifact_dir,
                    "--no-artifact": args.no_artifact}
     if args.mode != "serve":
@@ -833,38 +841,132 @@ def _next_serve_artifact(artifact_dir: str) -> str:
     return os.path.join(artifact_dir, f"BENCH_serve_r{n:02d}.json")
 
 
+def _host_provenance(factory) -> dict:
+    """Host + accelerator identity for the serve artifact: which machine
+    and which silicon produced the number. `device_kind` is the honest
+    chip name ('cpu' on the virtual mesh, 'TPU v4' etc. on real
+    hardware); chip_count restates the normalization denominator."""
+    import platform as platform_mod
+    import socket
+
+    return {
+        "hostname": socket.gethostname(),
+        "platform": platform_mod.platform(),
+        "machine": platform_mod.machine(),
+        "cpu_count": os.cpu_count(),
+        "backend": factory.platform,
+        "device_kind": factory.mesh.devices.flat[0].device_kind,
+        "chip_count": factory.n_chips,
+    }
+
+
+def _serve_swap_window(registry, factory, batcher, metrics, req,
+                       clients: int, duration: float, compiles,
+                       seed: int = 101) -> dict:
+    """Closed-loop window with a REAL model roll in the middle: after a
+    quarter of the window, load + pre-warm a second (fresh-init) version
+    on THIS thread while the clients keep hammering the live one, then
+    atomically promote it. Returns the swap record: whole-window latency
+    snapshot (spanning pre/during/post swap), the candidate's warmup
+    cost, and the compile-event count from post-warm to drain — the
+    recompiles_after_swap == 0 acceptance signal."""
+    import threading
+
+    from distributedmnist_tpu.serve import Rejected
+
+    client_errors: list = []
+    stop_evt = threading.Event()
+
+    def client():
+        while not stop_evt.is_set():
+            try:
+                batcher.submit(req).result(timeout=120)
+            except Rejected:
+                time.sleep(0.001)
+            except BaseException as e:
+                client_errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(min(0.5, duration * 0.2))     # unmeasured ramp
+    metrics.reset()
+    t_win0 = time.monotonic()
+    time.sleep(duration * 0.25)              # steady traffic pre-swap
+    version = "v-swap"
+    t_load0 = time.monotonic()
+    mv = registry.add(factory.init_params(seed), version=version,
+                      source="fresh-init")   # load + pre-warm: hot path
+    #                                          keeps serving throughout
+    steady_from = compiles.snapshot()        # post-warm, pre-promote
+    registry.promote(version)
+    t_swap = time.monotonic()
+    _mark(f"hot-swap: {version} warmed in {t_swap - t_load0:.2f}s "
+          f"({mv.warmup_compile_events} compile events), promoted")
+    # post-swap tail: the new version takes ALL traffic inside the same
+    # measured window, so a cold bucket would show up in THIS p99
+    time.sleep(max(duration * 0.5, 0.5))
+    stop_evt.set()
+    for t in threads:
+        t.join()
+    if client_errors:
+        raise RuntimeError(
+            f"{len(client_errors)} of {clients} swap-window clients "
+            "died — a hot-swap must not fail requests") \
+            from client_errors[0]
+    _drain_or_die(batcher, timeout=120)
+    recompiles = compiles.snapshot() - steady_from
+    snap = metrics.snapshot()
+    return {
+        "version": version,
+        "window_s": round(time.monotonic() - t_win0, 3),
+        "load_warm_s": round(mv.warmup_s, 3),
+        "warmup_compile_events": mv.warmup_compile_events,
+        "recompiles_after_swap": recompiles,
+        "swap_window": snap,
+    }
+
+
 def _serve(args) -> int:
     """Serving load harness: closed-loop capacity (the headline
     images/sec/chip) measured at the pipelined in-flight window AND at
     the serial inflight=1 baseline — the overlap win is a measured
     ratio, not a claim — plus an open-loop Poisson QPS sweep giving the
     latency-vs-throughput table (with an inflight=1 p99 comparison point
-    at the lowest, sub-capacity target). Same perf discipline as the
-    training bench: bucket warmup (compile) excluded from every window,
-    per-chip normalization, and a recompile counter proving steady state
-    ran shape-stable. The whole record is also written to a
-    BENCH_serve_r*.json artifact (--artifact-dir / --no-artifact)."""
+    at the lowest, sub-capacity target), and optionally
+    (--swap-during-load) a closed-loop window crossing a real pre-warmed
+    hot-swap. Same perf discipline as the training bench: bucket warmup
+    (compile) excluded from every window, per-chip normalization, and a
+    recompile counter proving steady state ran shape-stable. The whole
+    record is also written to a BENCH_serve_r*.json artifact
+    (--artifact-dir / --no-artifact)."""
     import numpy as np
 
     from distributedmnist_tpu.config import Config
     from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
-                                            build_engine)
+                                            build_serving)
+    from distributedmnist_tpu.utils import CompileCounter
 
     cfg = Config(model=args.model, dtype=args.dtype)
-    # Resolve backend-dependent defaults AFTER the engine is up (the
+    metrics = ServeMetrics()
+    # Resolve backend-dependent defaults AFTER the backend is up (the
     # same pattern as bench_steps): CPU phases are kept short — each
-    # sweep point costs its full wall-clock duration.
-    engine = build_engine(cfg.replace(
+    # sweep point costs its full wall-clock duration. build_serving
+    # loads no version, so the probe-then-rebuild costs nothing.
+    registry, router, factory = build_serving(cfg.replace(
         serve_max_batch=(cfg.serve_max_batch
                          if args.serve_max_batch is None
-                         else args.serve_max_batch)))
-    backend = engine.mesh.devices.flat[0].platform
+                         else args.serve_max_batch)), metrics=metrics)
+    backend = factory.platform
     on_cpu = backend == "cpu"
-    _mark(f"backend up: {engine.n_chips}x {backend}")
+    _mark(f"backend up: {factory.n_chips}x {backend}")
     if args.serve_max_batch is None and on_cpu:
         # rebuild with the CPU-sized bucket ladder (cheap: CPU compiles
         # are fast and the persistent cache absorbs repeats)
-        engine = build_engine(cfg.replace(serve_max_batch=128))
+        registry, router, factory = build_serving(
+            cfg.replace(serve_max_batch=128), metrics=metrics)
     # `is None` checks, not `or`: an explicit 0 (e.g. --serve-max-wait-us
     # 0 to measure the no-coalescing latency floor) must be honored.
     max_wait_us = (cfg.serve_max_wait_us if args.serve_max_wait_us is None
@@ -886,16 +988,18 @@ def _serve(args) -> int:
     pipelined = (4 if args.serve_max_inflight is None
                  else args.serve_max_inflight)
 
-    _mark(f"warming {len(engine.buckets)} buckets {list(engine.buckets)}")
-    warm_compiles = engine.warmup()
-    steady_from = engine.compile_events()
+    _mark(f"warming {len(factory.buckets)} buckets "
+          f"{list(factory.buckets)}")
+    boot = registry.bootstrap(seed=cfg.seed)   # load + pre-warm + promote
+    warm_compiles = boot.warmup_compile_events
+    compiles = CompileCounter.instance()
+    steady_from = compiles.snapshot()
 
-    metrics = ServeMetrics()
     rng = np.random.default_rng(0)
     req = rng.integers(0, 256, (rows, 28, 28, 1), dtype=np.uint8)
 
     def make_batcher(max_inflight: int) -> DynamicBatcher:
-        return DynamicBatcher(engine, max_batch=engine.max_batch,
+        return DynamicBatcher(router, max_batch=factory.max_batch,
                               max_wait_us=max_wait_us,
                               queue_depth=queue_depth,
                               max_inflight=max_inflight,
@@ -910,7 +1014,7 @@ def _serve(args) -> int:
     _mark(f"closed loop [inflight=1]: {clients} clients x {duration:.0f}s")
     closed_serial = _serve_closed_loop(serial, metrics, req, clients,
                                        duration)
-    serial_value = closed_serial["rows_per_sec"] / engine.n_chips
+    serial_value = closed_serial["rows_per_sec"] / factory.n_chips
     _mark(f"closed loop [inflight=1]: {serial_value:.0f} img/s/chip "
           f"(p99 {closed_serial['latency_ms']['p99']} ms)")
     _mark(f"open loop [inflight=1] qps={low_qps:g}")
@@ -924,7 +1028,7 @@ def _serve(args) -> int:
     _mark(f"closed loop [inflight={piped.max_inflight}]: "
           f"{clients} clients x {duration:.0f}s")
     closed = _serve_closed_loop(piped, metrics, req, clients, duration)
-    value = closed["rows_per_sec"] / engine.n_chips
+    value = closed["rows_per_sec"] / factory.n_chips
     speedup = value / max(serial_value, 1e-9)
     _mark(f"closed loop [inflight={piped.max_inflight}]: {value:.0f} "
           f"img/s/chip (p99 {closed['latency_ms']['p99']} ms, "
@@ -938,7 +1042,7 @@ def _serve(args) -> int:
             "qps_target": qps,
             "qps_submitted": round(submitted / duration, 1),
             "requests_per_sec": snap["requests_per_sec"],
-            "img_s_chip": round(snap["rows_per_sec"] / engine.n_chips,
+            "img_s_chip": round(snap["rows_per_sec"] / factory.n_chips,
                                 1),
             "latency_ms": snap["latency_ms"],
             "mean_rows_per_batch": snap["mean_rows_per_batch"],
@@ -950,9 +1054,53 @@ def _serve(args) -> int:
         _mark(f"open loop qps={qps:g}: p50="
               f"{snap['latency_ms']['p50']} ms, "
               f"{snap['rejected_requests']} rejected")
+
+    # Phase 3 (optional) — the model roll: closed-loop traffic crossing
+    # a real load + pre-warm + atomic promote (ISSUE 3 acceptance:
+    # recompiles_after_swap == 0 and swap-window p99 within 1.5x the
+    # steady-state p99 on the same host). Runs BEFORE the whole-run
+    # recompile check so the candidate's legitimate warmup compiles are
+    # excluded from it (steady_from is re-sampled inside).
+    swap = None
+    if args.swap_during_load:
+        _mark(f"swap window [inflight={piped.max_inflight}]: "
+              f"{clients} clients, hot-swap mid-window")
+        swap = _serve_swap_window(registry, factory, piped, metrics, req,
+                                  clients, duration, compiles)
+        steady_p99 = closed["latency_ms"]["p99"]
+        swap_p99 = swap["swap_window"]["latency_ms"]["p99"]
+        swap["steady_p99_ms"] = steady_p99
+        swap["swap_window_p99_ms"] = swap_p99
+        swap["p99_ratio_vs_steady"] = (
+            round(swap_p99 / steady_p99, 3)
+            if steady_p99 and swap_p99 is not None else None)
+        # The decomposed tail: the new version serves ONLY after the
+        # promote, so its by_version p99 is the pure post-swap
+        # population — the Clockwork claim ("no cold buckets after the
+        # swap") in one number. The whole-window ratio above
+        # additionally charges the candidate's warmup-time host-CPU
+        # contention to the OLD version's requests, which on a
+        # shared-core (CPU) host dominates the window; on a TPU host
+        # compile is host-side work while serving compute is on-device,
+        # so the two ratios converge.
+        post = swap["swap_window"]["by_version"].get(swap["version"])
+        post_p99 = post["latency_ms"]["p99"] if post else None
+        swap["post_swap_p99_ms"] = post_p99
+        swap["post_swap_p99_ratio_vs_steady"] = (
+            round(post_p99 / steady_p99, 3)
+            if steady_p99 and post_p99 is not None else None)
+        _mark(f"swap window: p99 {swap_p99} ms vs steady {steady_p99} ms"
+              f" (ratio {swap['p99_ratio_vs_steady']}; post-swap "
+              f"population {post_p99} ms, ratio "
+              f"{swap['post_swap_p99_ratio_vs_steady']}), "
+              f"{swap['recompiles_after_swap']} recompiles after swap")
     piped.stop()
 
-    recompiles = engine.compile_events() - steady_from
+    recompiles = compiles.snapshot() - steady_from
+    if swap is not None:
+        # the candidate's warmup compiles are warmup, not steady-state
+        # recompiles — same exclusion the boot warmup gets
+        recompiles -= swap["warmup_compile_events"]
     if recompiles:
         _mark(f"WARNING: {recompiles} compile events after warmup — "
               "steady state was supposed to be shape-stable")
@@ -970,20 +1118,27 @@ def _serve(args) -> int:
             "model": args.model,
             "dtype": args.dtype,
             "backend": backend,
-            "n_chips": engine.n_chips,
-            "buckets": list(engine.buckets),
-            "max_batch": engine.max_batch,
+            "n_chips": factory.n_chips,
+            # Provenance: where this number was measured. CPU-host
+            # numbers (like the 1.08x PR 2 result) must never be
+            # conflated with TPU headlines when comparing rounds — the
+            # host block makes every BENCH_serve_r*.json self-locating.
+            "host": _host_provenance(factory),
+            "buckets": list(factory.buckets),
+            "max_batch": factory.max_batch,
             "max_wait_us": max_wait_us,
             "queue_depth": queue_depth,
             "max_inflight": piped.max_inflight,
             "rows_per_request": rows,
             "clients": clients,
             "duration_s": duration,
-            "params": "fresh-init",
+            "params": boot.source,
+            "live_version_final": registry.live_version(),
             "warmup_compile_events": warm_compiles,
             "recompiles_after_warmup": recompiles,
             "closed_loop": closed,
             "qps_sweep": table,
+            "swap": swap,
             # The measured overlap win (ISSUE 2 acceptance): pipelined
             # capacity over the serial chain, and sub-capacity open-loop
             # latency at both depths — pipelining must buy throughput

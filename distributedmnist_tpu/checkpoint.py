@@ -17,12 +17,94 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 log = logging.getLogger("distributedmnist_tpu")
+
+
+def committed_steps(directory: str) -> list[int]:
+    """Step numbers of checkpoints fully COMMITTED in `directory`. An
+    in-progress async save lives in a tmp-suffixed dir, never an
+    all-digit one, so the digit-only listing is exactly the committed
+    set (the same invariant tests/conftest.py polls on)."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(int(d) for d in os.listdir(directory) if d.isdigit())
+
+
+def restore_latest_params(directory: str, abstract_params: Any,
+                          step: Optional[int] = None
+                          ) -> Tuple[Any, Optional[int]]:
+    """Params-only restore for SERVING: read just the `params` subtree of
+    the latest committed checkpoint, never touching the optimizer slots.
+
+    A served model needs its weights, not its Adam moments — a full-state
+    restore reads 3x the bytes (params + mu + nu) and holds the extra
+    arrays until GC, which multiplies across every version a model
+    registry keeps warm. This path hands orbax an `item` tree containing
+    ONLY `params` (with `transforms={}` so unnamed checkpoint entries are
+    skipped, not structure-checked): the opt_state/step bytes are never
+    read from disk. It also makes serving restores layout-agnostic: a
+    checkpoint written under either optimizer-state layout
+    (config.flat_optimizer) serves identically, with none of
+    maybe_restore()'s flat<->per-leaf conversion machinery involved.
+
+    `abstract_params` is a params-shaped pytree of jax.ShapeDtypeStruct
+    (shapes, dtypes AND target shardings). Returns (params, step), or
+    (None, None) when the directory holds no committed checkpoint. A
+    checkpoint whose params don't match the abstract tree raises
+    ValueError naming the directory. Pass `step` to pin a specific
+    committed step instead of the latest (callers that listed the
+    directory themselves — e.g. an idempotency check — must restore the
+    step they decided on, not whatever landed since).
+    """
+    if step is None:
+        steps = committed_steps(directory)
+        if not steps:
+            return None, None
+        step = steps[-1]
+    # CheckpointManager writes each item under <dir>/<step>/<item_name>;
+    # StandardSave's default item name is "default". Fall back to the
+    # bare step dir for trees saved without the item wrapper.
+    path = os.path.join(os.path.abspath(directory), str(step), "default")
+    if not os.path.isdir(path):
+        path = os.path.join(os.path.abspath(directory), str(step))
+    item = {"params": abstract_params}
+    restore_args = jax.tree.map(
+        lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding,
+                                       global_shape=x.shape,
+                                       dtype=x.dtype), item)
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=item, restore_args=restore_args, transforms={}))
+    except (ValueError, TypeError, KeyError) as e:
+        raise ValueError(
+            f"checkpoint at step {step} in {directory!r} has no params "
+            "subtree matching this model's structure (params-only "
+            f"serving restore); original error: {e}") from e
+    finally:
+        ckptr.close()
+    params = restored["params"]
+    # The transforms fallback is silently lenient: a requested path
+    # ABSENT from the checkpoint is passed through as the abstract
+    # placeholder instead of raising — a wrong-model checkpoint would
+    # otherwise hand serving a Frankenstein tree of real arrays and
+    # ShapeDtypeStructs. Validate every leaf restored, loudly.
+    missing = [
+        jax.tree_util.keystr(path)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+        if isinstance(leaf, jax.ShapeDtypeStruct)]
+    if missing:
+        raise ValueError(
+            f"checkpoint at step {step} in {directory!r} does not hold "
+            f"params for this model: {len(missing)} leaf/leaves missing "
+            f"(e.g. {missing[:3]}) — params-only serving restore "
+            "requires an exact params-tree match")
+    return params, step
 
 
 class Checkpointer:

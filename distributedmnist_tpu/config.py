@@ -117,6 +117,11 @@ class Config:
     # (1 on CPU, where staging and compute share the same cores; a small
     # window on accelerators). 1 = the fully serial chain.
     serve_max_inflight: Optional[int] = None
+    # model lifecycle (serve/registry.py): how many warmed versions the
+    # registry keeps resident (live + rollback/candidate set). Each
+    # resident version pins a full param set in device memory — the cap
+    # bounds HBM cost; past it the oldest routeless version is evicted.
+    serve_max_versions: int = 4
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -223,6 +228,10 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="[serving] max dispatched-but-unfetched batches "
                         "kept in flight (pipelined dispatch; default: "
                         "1 on cpu, 4 on accelerators)")
+    p.add_argument("--serve-max-versions", type=int, default=None,
+                   help="[serving] warmed model versions kept resident "
+                        "in the registry (live + rollback/candidates); "
+                        "each pins one param set in device memory")
     p.add_argument("--no-flat-optimizer", dest="flat_optimizer",
                    action="store_false", default=None,
                    help="per-leaf optimizer update instead of the fused "

@@ -1,12 +1,17 @@
-"""Batched inference serving (ISSUE 1): the forward-only half of the
+"""Batched inference serving (ISSUES 1-3): the forward-only half of the
 north star's "serves heavy traffic from millions of users".
 
 - engine.py   bucketed, jitted, donated forward step over the 'data' mesh,
               split into dispatch()/fetch() around the async device queue
 - batcher.py  dynamic micro-batcher pipelined through a bounded in-flight
               window, with bounded-queue backpressure
-- metrics.py  latency percentiles / occupancy / qps / pipeline depth and
-              staging-vs-fetch split, JSON-line records
+- metrics.py  latency percentiles / occupancy / qps / pipeline depth,
+              staging-vs-fetch split, per-version populations and
+              shadow-comparison aggregates, JSON-line records
+- registry.py checkpoint-backed versioned model store: params-only
+              restore, pre-warmed engines, atomic promotion, eviction
+- router.py   version-aware dispatch between batcher and engines:
+              hot-swap, shadow duplication, canary splitting
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -18,6 +23,8 @@ _EXPORTS = {
     "InferenceHandle": ("distributedmnist_tpu.serve.engine",
                         "InferenceHandle"),
     "build_engine": ("distributedmnist_tpu.serve.engine", "build_engine"),
+    "build_model_and_mesh": ("distributedmnist_tpu.serve.engine",
+                             "build_model_and_mesh"),
     "make_buckets": ("distributedmnist_tpu.serve.engine", "make_buckets"),
     "DynamicBatcher": ("distributedmnist_tpu.serve.batcher",
                        "DynamicBatcher"),
@@ -25,6 +32,17 @@ _EXPORTS = {
     "resolve_max_inflight": ("distributedmnist_tpu.serve.batcher",
                              "resolve_max_inflight"),
     "ServeMetrics": ("distributedmnist_tpu.serve.metrics", "ServeMetrics"),
+    "EngineFactory": ("distributedmnist_tpu.serve.registry",
+                      "EngineFactory"),
+    "ModelRegistry": ("distributedmnist_tpu.serve.registry",
+                      "ModelRegistry"),
+    "ModelVersion": ("distributedmnist_tpu.serve.registry",
+                     "ModelVersion"),
+    "build_serving": ("distributedmnist_tpu.serve.registry",
+                      "build_serving"),
+    "Router": ("distributedmnist_tpu.serve.router", "Router"),
+    "RoutedHandle": ("distributedmnist_tpu.serve.router", "RoutedHandle"),
+    "NoLiveModel": ("distributedmnist_tpu.serve.router", "NoLiveModel"),
 }
 
 __all__ = list(_EXPORTS)

@@ -273,19 +273,29 @@ class DynamicBatcher:
                 self._slots.release()
                 continue
             t_done = time.monotonic()
+            version = getattr(handle, "version", None)
             off = 0
             for r in batch:
+                # Attribution rides the future itself (set BEFORE
+                # set_result, so a waiter that has seen the result also
+                # sees the tag): serve.py reports which model version
+                # actually computed THIS request — under canary routing
+                # that is not necessarily the live version.
+                r.future.version = version
                 r.future.set_result(logits[off:off + r.n])
                 off += r.n
             if self.metrics is not None:
                 rows = sum(r.n for r in batch)
+                # Same version tag (serve/registry.py labels): the
+                # canary population's metrics separate from the live
+                # population's. Bare-engine handles tag None (untagged).
                 self.metrics.record_fetch(t_done - t0)
                 self.metrics.record_batch(
                     rows=rows, bucket=handle.bucket,
-                    queue_depth=self.pending_rows())
+                    queue_depth=self.pending_rows(), version=version)
                 for r in batch:
                     self.metrics.record_latency(t_done - r.t_enqueue,
-                                                rows=r.n)
+                                                rows=r.n, version=version)
             # A batch leaves the in-flight count (and frees its window
             # slot) only AFTER its futures resolved and its metrics
             # landed: inflight_batches()==0 with an empty queue then

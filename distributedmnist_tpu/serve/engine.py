@@ -48,6 +48,8 @@ class InferenceHandle:
     n: int                        # real rows (the rest is padding)
     bucket: int
     staging: Optional[np.ndarray]  # recycled on fetch; None after
+    version: Optional[str] = None  # the model version that computed it
+    #   (serve/registry.py labels; metrics split populations on it)
 
 
 def make_buckets(max_batch: int, n_chips: int,
@@ -77,7 +79,8 @@ class InferenceEngine:
 
     def __init__(self, model, params, mesh, dtype=None,
                  max_batch: int = 512,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 version: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -87,6 +90,7 @@ class InferenceEngine:
 
         enable_compilation_cache()
         self._compiles = CompileCounter.instance()
+        self.version = version
         self.mesh = mesh
         self.n_chips = int(np.prod(mesh.devices.shape))
         self.platform = mesh.devices.flat[0].platform
@@ -195,7 +199,7 @@ class InferenceEngine:
         x_dev = jax.device_put(staging, self._x_sharding)
         logits = self._forward(self.params, x_dev)
         return InferenceHandle(logits=logits, n=n, bucket=b,
-                               staging=staging)
+                               staging=staging, version=self.version)
 
     def fetch(self, handle: InferenceHandle) -> np.ndarray:
         """Phase 2: the device->host VALUE fetch (blocks until the
@@ -236,17 +240,15 @@ class InferenceEngine:
         return self._compiles.snapshot()
 
 
-def build_engine(cfg) -> InferenceEngine:
-    """InferenceEngine from a Config: the model/dtype/mesh the training
-    CLI would build, params restored from cfg.checkpoint_dir when one
-    exists there (a served model is usually a trained one), fresh-init
-    otherwise (load harnesses measure throughput, not accuracy)."""
-    import jax
+def build_model_and_mesh(cfg):
+    """The (model, mesh, dtype) triple every serving engine of a process
+    is built over — shared by build_engine and the model registry's
+    EngineFactory so all versions compile the same program geometry.
+    Rejects training-only knobs rather than silently ignoring them."""
     import jax.numpy as jnp
 
-    from distributedmnist_tpu import models, optim
+    from distributedmnist_tpu import models
     from distributedmnist_tpu.parallel import get_devices, make_mesh
-    from distributedmnist_tpu.trainer import init_state
 
     if cfg.model_parallel != 1:
         raise ValueError(
@@ -263,6 +265,25 @@ def build_engine(cfg) -> InferenceEngine:
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     model = models.build(cfg.model, dtype=dtype, fused=cfg.fused_kernels,
                          platform=devices[0].platform, conv=cfg.conv_impl)
+    return model, mesh, dtype
+
+
+def build_engine(cfg) -> InferenceEngine:
+    """InferenceEngine from a Config: the model/dtype/mesh the training
+    CLI would build, params restored from cfg.checkpoint_dir when one
+    exists there (a served model is usually a trained one), fresh-init
+    otherwise (load harnesses measure throughput, not accuracy).
+
+    The single-version path. Serving that must roll new checkpoints in
+    without dropping traffic goes through serve/registry.py's
+    ModelRegistry + Router instead (serve.py does)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import optim
+    from distributedmnist_tpu.trainer import init_state
+
+    model, mesh, dtype = build_model_and_mesh(cfg)
     tx = optim.build(cfg.optimizer, cfg.learning_rate, cfg.momentum,
                      flat=cfg.flat_optimizer)
     state = init_state(jax.random.PRNGKey(cfg.seed), model, tx,

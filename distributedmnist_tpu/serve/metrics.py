@@ -52,14 +52,36 @@ class ServeMetrics:
             self._dispatches = 0
             self._inflight_sum = 0
             self._inflight_max = 0
+            # model-lifecycle split (ISSUE 3): per-version populations
+            # (canary vs live separability) and shadow-comparison
+            # aggregates. Keyed by the version labels the registry
+            # assigns; requests served before version plumbing existed
+            # (or by a bare engine) simply don't tag.
+            self._by_version: dict[str, dict] = {}
+            self._shadow: dict[str, dict] = {}   # "live->shadow" pairs
+            self._shadow_errors = 0
+            self._shadow_dropped = 0
 
     # -- recording hooks (called by the batcher) ---------------------------
 
-    def record_latency(self, seconds: float, rows: int = 1) -> None:
+    def _version_stats(self, version: str) -> dict:
+        # caller holds the lock; per-version latency deques are smaller
+        # than the global one (populations are a fraction of traffic)
+        return self._by_version.setdefault(version, {
+            "requests": 0, "rows": 0, "batches": 0,
+            "lat": deque(maxlen=min(self._max_samples, 10_000))})
+
+    def record_latency(self, seconds: float, rows: int = 1,
+                       version: str = None) -> None:
         with self._lock:
             self._lat_s.append(seconds)
             self._requests += 1
             self._rows += rows
+            if version is not None:
+                v = self._version_stats(version)
+                v["requests"] += 1
+                v["rows"] += rows
+                v["lat"].append(seconds)
 
     def record_dispatch(self, staging_seconds: float,
                         inflight: int = 1) -> None:
@@ -77,7 +99,7 @@ class ServeMetrics:
             self._fetch_s.append(seconds)
 
     def record_batch(self, rows: int, bucket: int,
-                     queue_depth: int) -> None:
+                     queue_depth: int, version: str = None) -> None:
         with self._lock:
             self._batches += 1
             occ = self._occupancy.setdefault(bucket, [0, 0])
@@ -85,11 +107,41 @@ class ServeMetrics:
             occ[1] += rows
             self._depth_sum += queue_depth
             self._depth_max = max(self._depth_max, queue_depth)
+            if version is not None:
+                self._version_stats(version)["batches"] += 1
 
     def record_reject(self, rows: int = 1) -> None:
         with self._lock:
             self._rejected_requests += 1
             self._rejected_rows += rows
+
+    def record_shadow(self, live_version: str, shadow_version: str,
+                      rows: int, agree_rows: int,
+                      max_abs_diff: float) -> None:
+        """One shadowed batch compared: how many rows' argmax classes
+        agreed between live and candidate, and the worst logit gap."""
+        with self._lock:
+            s = self._shadow.setdefault(
+                f"{live_version}->{shadow_version}",
+                {"batches": 0, "rows": 0, "agree_rows": 0,
+                 "max_abs_diff": 0.0})
+            s["batches"] += 1
+            s["rows"] += rows
+            s["agree_rows"] += agree_rows
+            s["max_abs_diff"] = max(s["max_abs_diff"], max_abs_diff)
+
+    def record_shadow_error(self) -> None:
+        """A shadow dispatch/fetch failed (swallowed — live traffic is
+        unaffected, but a broken candidate must be visible)."""
+        with self._lock:
+            self._shadow_errors += 1
+
+    def record_shadow_drop(self) -> None:
+        """A sampled batch skipped its shadow duplicate because the
+        outstanding-duplication cap was hit (slow/wedged candidate):
+        the comparison coverage silently shrinking must be visible."""
+        with self._lock:
+            self._shadow_dropped += 1
 
     # -- reporting ---------------------------------------------------------
 
@@ -131,6 +183,24 @@ class ServeMetrics:
                     round(self._inflight_sum / self._dispatches, 2)
                     if self._dispatches else None),
                 "inflight_max": self._inflight_max,
+                "by_version": {
+                    v: {"requests": s["requests"], "rows": s["rows"],
+                        "batches": s["batches"],
+                        "latency_ms": {
+                            k: (round(x * 1e3, 3) if x is not None
+                                else None)
+                            for k, x in percentiles(
+                                list(s["lat"])).items()}}
+                    for v, s in sorted(self._by_version.items())},
+                "shadow": {
+                    pair: {**s,
+                           "agreement": (round(s["agree_rows"]
+                                               / s["rows"], 4)
+                                         if s["rows"] else None),
+                           "max_abs_diff": round(s["max_abs_diff"], 6)}
+                    for pair, s in sorted(self._shadow.items())},
+                "shadow_errors": self._shadow_errors,
+                "shadow_dropped": self._shadow_dropped,
             }
 
     def record(self) -> dict:

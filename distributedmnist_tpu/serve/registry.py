@@ -1,0 +1,432 @@
+"""Checkpoint-backed model lifecycle: versioned param sets, pre-warmed
+engines, atomic promotion.
+
+PRs 1-2 made serving fast but frozen: one engine, one param set, loaded
+at process start. Rolling in a newly trained checkpoint meant killing the
+server. This module is the model-abstraction layer above the compute
+engine (the Clipper decomposition): a **ModelRegistry** that
+
+1. **loads** versioned param sets — params-only restore from checkpoint
+   directories (checkpoint.restore_latest_params; no optimizer slots
+   read), or params handed in directly (fresh-init bench/gate paths);
+2. **pre-warms** every bucket of the new version's jitted forward OFF the
+   hot path, then proves warmth by re-running warmup and asserting zero
+   compile events (Clockwork's rule: a model never takes live traffic
+   until its programs are fully compiled — one cold bucket after a swap
+   would poison tail latency for every later request that lands in it);
+3. **promotes** a warmed version by atomically re-pointing the Router's
+   live target while the dispatch thread keeps running — in-flight
+   batches finish on the engine their handle captured, the next batch
+   runs the new version, and no request ever observes a mixed-version
+   result;
+4. keeps a bounded set of warmed versions resident (rollback = promote a
+   previous version; eviction drops the oldest routeless version so HBM
+   isn't a leak of every checkpoint ever loaded).
+
+All versions in one registry share one EngineFactory — same model, mesh,
+dtype, bucket ladder — so a swap can never change compile geometry, which
+is what keeps recompiles_after_warmup == 0 true ACROSS swaps, not just
+within one engine's steady state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from distributedmnist_tpu.serve.engine import InferenceEngine, make_buckets
+from distributedmnist_tpu.serve.router import Router
+
+log = logging.getLogger("distributedmnist_tpu")
+
+# Version lifecycle: warming -> ready -> live -> ready (demoted, can be
+# re-promoted as a rollback) -> evicted. "failed" is terminal (warmup
+# did not reach the compiled-everywhere bar).
+STATES = ("warming", "ready", "live", "failed")
+
+
+@dataclasses.dataclass
+class ModelVersion:
+    version: str
+    engine: Any
+    state: str
+    source: str                    # "checkpoint <dir>" | "fresh-init" | ...
+    step: Optional[int] = None     # checkpoint step, when from disk
+    warmup_compile_events: int = 0
+    warmup_s: float = 0.0
+    loaded_at: float = 0.0         # time.time()
+
+    def describe(self) -> dict:
+        return {
+            "version": self.version,
+            "state": self.state,
+            "source": self.source,
+            "step": self.step,
+            "warmup_compile_events": self.warmup_compile_events,
+            "warmup_s": round(self.warmup_s, 3),
+            "loaded_at": round(self.loaded_at, 3),
+        }
+
+
+class EngineFactory:
+    """Builds shape-identical InferenceEngines, one per model version.
+
+    Owns the shared geometry (model, mesh, dtype, bucket ladder) so every
+    version compiles the same set of programs, and exposes the abstract
+    params tree (shapes/dtypes/replicated sharding) the params-only
+    checkpoint restore needs — computed via eval_shape, no device work."""
+
+    def __init__(self, model, mesh, dtype=None, max_batch: int = 512,
+                 buckets: Optional[Sequence[int]] = None):
+        self.model = model
+        self.mesh = mesh
+        self.dtype = dtype
+        self.max_batch = max_batch
+        self.n_chips = int(np.prod(mesh.devices.shape))
+        self.platform = mesh.devices.flat[0].platform
+        self.buckets = (tuple(sorted(set(buckets))) if buckets
+                        else make_buckets(max_batch, self.n_chips))
+
+    def make_router(self, metrics=None, seed: int = 0) -> Router:
+        return Router(self.max_batch, self.buckets, self.platform,
+                      n_chips=self.n_chips, metrics=metrics, seed=seed)
+
+    def make_engine(self, params, version: str) -> InferenceEngine:
+        return InferenceEngine(self.model, params, self.mesh,
+                               dtype=self.dtype, max_batch=self.max_batch,
+                               buckets=self.buckets, version=version)
+
+    def init_params(self, seed: int = 0):
+        """Fresh-init params (load harnesses and gates measure plumbing
+        and throughput, not accuracy), replicated over the mesh."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributedmnist_tpu.parallel import replicated
+
+        params = self.model.init(jax.random.PRNGKey(seed),
+                                 jnp.zeros((1, 28, 28, 1)))["params"]
+        return jax.device_put(params, replicated(self.mesh))
+
+    def abstract_params(self):
+        """Params-shaped ShapeDtypeStruct tree with replicated sharding —
+        the restore target for checkpoint.restore_latest_params."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributedmnist_tpu.parallel import replicated
+
+        shapes = jax.eval_shape(
+            lambda k: self.model.init(k, jnp.zeros((1, 28, 28, 1)))
+            ["params"], jax.random.PRNGKey(0))
+        sharding = replicated(self.mesh)
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=sharding), shapes)
+
+
+class ModelRegistry:
+    """Versioned, pre-warmed model store feeding one Router.
+
+    Two locks with distinct jobs: `_admin` (RLock) serializes the slow
+    mutating operations (add/load/promote/set_shadow/set_canary — they
+    run on admin/HTTP/SIGHUP threads, never the dispatch thread, so
+    warmup is always off the hot path); `_state` (Lock) guards only the
+    version table itself and is held for dict operations, never across
+    a restore or a warmup — so /healthz and GET /models (describe())
+    answer instantly even while a multi-second candidate warmup is in
+    flight. The dispatch thread waits on neither: it only ever crosses
+    the Router's pointer lock (nanoseconds, not a compile)."""
+
+    def __init__(self, factory: EngineFactory, router: Router,
+                 checkpoint_dir: Optional[str] = None,
+                 max_versions: int = 4):
+        if max_versions < 2:
+            raise ValueError(
+                f"max_versions must be >= 2 (live + one candidate), "
+                f"got {max_versions}")
+        from distributedmnist_tpu.utils import CompileCounter
+
+        self.factory = factory
+        self.router = router
+        self.checkpoint_dir = checkpoint_dir
+        self.max_versions = max_versions
+        self._versions: dict[str, ModelVersion] = {}   # insertion-ordered
+        self._admin = threading.RLock()
+        self._state = threading.Lock()
+        self._compiles = CompileCounter.instance()
+        self._auto_id = 0
+
+    # -- loading -----------------------------------------------------------
+
+    def add(self, params, version: Optional[str] = None,
+            source: str = "direct", step: Optional[int] = None
+            ) -> ModelVersion:
+        """Register + pre-warm a param set. Returns the ModelVersion in
+        state 'ready' (promotable). Raises if the version name is taken,
+        if the registry is full of route-holding versions (BEFORE any
+        warmup work is spent), or if warmup cannot reach the
+        compiled-everywhere bar."""
+        with self._admin:
+            with self._state:
+                if version is None:
+                    self._auto_id += 1
+                    version = f"v{self._auto_id}"
+                if version in self._versions:
+                    raise ValueError(f"version {version!r} already loaded")
+                # Capacity check up front: if every resident version
+                # holds a routing role, eviction could free nothing and
+                # the newcomer itself would be the only evictable entry
+                # — refuse NOW rather than warm an engine just to drop
+                # it (or silently exceed the HBM cap).
+                in_route = self.router.versions_in_route()
+                evictable = [n for n, v in self._versions.items()
+                             if v.state == "failed"
+                             or (v.state == "ready" and n not in in_route)]
+                if len(self._versions) >= self.max_versions \
+                        and not evictable:
+                    raise RuntimeError(
+                        f"registry full: {len(self._versions)} resident "
+                        "versions all hold routing roles (live/shadow/"
+                        "canary); clear a candidate or raise "
+                        "serve_max_versions")
+                mv = ModelVersion(version=version, engine=None,
+                                  state="warming", source=source,
+                                  step=step, loaded_at=time.time())
+                self._versions[version] = mv
+            # Warmup runs OUTSIDE the state lock (it is seconds of XLA
+            # compile): /healthz and GET /models stay answerable — they
+            # see this version honestly in state 'warming'. The admin
+            # lock still serializes concurrent loads.
+            try:
+                t0 = time.perf_counter()
+                engine = self.factory.make_engine(params, version)
+                mv.warmup_compile_events = engine.warmup()
+                # Clockwork bar: prove EVERY bucket is compiled by
+                # re-running warmup — a pure jit-cache pass costs zero
+                # compile events or this version must not take traffic.
+                residual = engine.warmup()
+                if residual:
+                    raise RuntimeError(
+                        f"version {version!r} still compiled {residual} "
+                        "time(s) on the verification warmup pass — "
+                        "refusing to mark it promotable")
+                mv.engine = engine
+                mv.warmup_s = time.perf_counter() - t0
+                mv.state = "ready"
+            except Exception:
+                mv.state = "failed"
+                mv.engine = None     # don't pin a half-warm engine's HBM
+                raise
+            with self._state:
+                self._evict_locked(protect={version})
+            log.info(
+                "registry: %s ready (%s, %d compile events, %.2fs warm)",
+                version, source, mv.warmup_compile_events, mv.warmup_s)
+            return mv
+
+    def load_latest(self, directory: Optional[str] = None,
+                    version: Optional[str] = None) -> ModelVersion:
+        """Load + pre-warm the latest committed checkpoint of `directory`
+        (default: the registry's checkpoint_dir) via the params-only
+        restore. Idempotent per checkpoint step: re-loading an already
+        resident step returns the existing version instead of burning a
+        duplicate engine's HBM (SIGHUP can fire repeatedly)."""
+        from distributedmnist_tpu.checkpoint import restore_latest_params
+
+        directory = directory or self.checkpoint_dir
+        if not directory:
+            raise ValueError(
+                "no checkpoint directory: pass one or construct the "
+                "registry with checkpoint_dir")
+        from distributedmnist_tpu.checkpoint import committed_steps
+
+        with self._admin:
+            # Residency check BEFORE the restore: a periodic SIGHUP with
+            # no new checkpoint must cost one listdir, not a full
+            # params read + device placement that is then discarded.
+            steps = committed_steps(directory)
+            if not steps:
+                raise FileNotFoundError(
+                    f"no committed checkpoint in {directory!r}")
+            step = steps[-1]
+            if version is None:
+                version = f"step-{step}"
+            with self._state:
+                existing = self._versions.get(version)
+                if existing is not None and existing.state != "failed":
+                    if existing.step == step:
+                        log.info("registry: %s already resident "
+                                 "(state %s)", version, existing.state)
+                        return existing
+                    # An explicit name pointing at OLDER params than the
+                    # latest commit must not masquerade as a fresh load.
+                    raise ValueError(
+                        f"version {version!r} already holds step "
+                        f"{existing.step}; latest committed step is "
+                        f"{step} — pick a new version name (or omit it "
+                        "for step-derived names)")
+                if existing is not None:      # failed: allow a retry
+                    del self._versions[version]
+            # Pin the step decided above: a checkpoint committing
+            # between the listing and the restore must not smuggle
+            # newer params in under the older step's version name.
+            params, step = restore_latest_params(
+                directory, self.factory.abstract_params(), step=step)
+            return self.add(params, version=version,
+                            source=f"checkpoint {directory}", step=step)
+
+    def bootstrap(self, seed: int = 0) -> ModelVersion:
+        """The process-start path: latest checkpoint if the registry's
+        checkpoint_dir holds one, fresh-init params otherwise — then
+        promote, so exactly one call takes a cold process to a live,
+        fully-warmed model. If some OTHER version went live while this
+        one warmed (an admin load+promote or SIGHUP raced the boot
+        thread), the operator's newer choice wins: bootstrap must never
+        silently revert live traffic to its own (possibly fresh-init)
+        params."""
+        from distributedmnist_tpu.checkpoint import committed_steps
+
+        if self.checkpoint_dir and committed_steps(self.checkpoint_dir):
+            mv = self.load_latest()
+        else:
+            mv = self.add(self.factory.init_params(seed),
+                          source="fresh-init")
+        with self._admin:
+            live = self.live_version()
+            if live is None or live == mv.version:
+                self.promote(mv.version)
+            else:
+                log.info(
+                    "bootstrap: %s went live during warmup; leaving it "
+                    "(%s stays ready)", live, mv.version)
+        return mv
+
+    # -- routing -----------------------------------------------------------
+
+    def promote(self, version: str) -> ModelVersion:
+        """Atomic hot-swap: `version` (which must be warmed: 'ready' or
+        already 'live') becomes the live target. The demoted version
+        stays resident in state 'ready' — rollback is promote(old)."""
+        with self._admin, self._state:
+            mv = self._get(version)
+            if mv.state not in ("ready", "live"):
+                raise RuntimeError(
+                    f"version {version!r} is {mv.state!r}; only a warmed "
+                    "('ready') version may take live traffic")
+            prev = self.router.live_version()
+            self.router.set_live(mv.engine, version)
+            mv.state = "live"
+            if prev is not None and prev != version:
+                old = self._versions.get(prev)
+                if old is not None:
+                    old.state = "ready"
+            self._evict_locked(protect={version})
+            return mv
+
+    def set_shadow(self, version: str, fraction: float = 0.1
+                   ) -> ModelVersion:
+        """Duplicate `fraction` of live traffic to `version`; its results
+        are compared + discarded, never returned to clients."""
+        with self._admin, self._state:
+            mv = self._get(version)
+            if mv.state != "ready":
+                raise RuntimeError(
+                    f"version {version!r} is {mv.state!r}; only a warmed "
+                    "non-live version can shadow")
+            self.router.set_shadow(mv.engine, version, fraction)
+            return mv
+
+    def set_canary(self, version: str, fraction: float = 0.1
+                   ) -> ModelVersion:
+        """Route `fraction` of traffic to `version` for real, with
+        version-tagged metrics separating the two populations."""
+        with self._admin, self._state:
+            mv = self._get(version)
+            if mv.state != "ready":
+                raise RuntimeError(
+                    f"version {version!r} is {mv.state!r}; only a warmed "
+                    "non-live version can take canary traffic")
+            self.router.set_canary(mv.engine, version, fraction)
+            return mv
+
+    def clear_candidates(self) -> None:
+        self.router.clear_candidates()
+
+    # -- introspection -----------------------------------------------------
+
+    def _get(self, version: str) -> ModelVersion:
+        mv = self._versions.get(version)
+        if mv is None:
+            raise KeyError(f"unknown version {version!r}; loaded: "
+                           f"{sorted(self._versions)}")
+        return mv
+
+    def get(self, version: str) -> ModelVersion:
+        with self._state:
+            return self._get(version)
+
+    def live_version(self) -> Optional[str]:
+        return self.router.live_version()
+
+    def describe(self) -> dict:
+        """GET /models payload: every resident version plus the routing
+        table."""
+        # _state only — never blocked by an in-flight warmup, so
+        # /healthz and GET /models answer during a multi-second load
+        with self._state:
+            return {
+                "versions": [mv.describe()
+                             for mv in self._versions.values()],
+                "routes": self.router.routes(),
+                "max_versions": self.max_versions,
+                "checkpoint_dir": self.checkpoint_dir,
+                "buckets": list(self.factory.buckets),
+                "max_batch": self.factory.max_batch,
+            }
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evict_locked(self, protect: set = frozenset()) -> None:
+        """Drop oldest routeless versions past max_versions (caller
+        holds _state). 'failed' entries are dropped first (they hold no
+        engine); versions in `protect` (the one just added/promoted) are
+        never candidates — eviction must not swallow the entry whose
+        operation triggered it. An engine still referenced by in-flight
+        handles is freed only after its last fetch — handles pin their
+        engine, so eviction can never yank a batch's program out from
+        under it."""
+        in_route = self.router.versions_in_route()
+        while len(self._versions) > self.max_versions:
+            for name, mv in list(self._versions.items()):
+                if name in protect:
+                    continue
+                if mv.state == "failed" or (
+                        mv.state == "ready" and name not in in_route):
+                    del self._versions[name]
+                    log.info("registry: evicted %s (%s)", name, mv.state)
+                    break
+            else:
+                return            # everything left is live or in-route
+
+
+def build_serving(cfg, metrics=None):
+    """(registry, router, factory) from a Config — the multi-version
+    sibling of engine.build_engine. No version is loaded yet: callers
+    decide boot order (serve.py bootstraps in a warm thread so /healthz
+    can report 'warming' while the HTTP server is already up)."""
+    from distributedmnist_tpu.serve.engine import build_model_and_mesh
+
+    model, mesh, dtype = build_model_and_mesh(cfg)
+    factory = EngineFactory(model, mesh, dtype=dtype,
+                            max_batch=cfg.serve_max_batch)
+    router = factory.make_router(metrics=metrics, seed=cfg.seed)
+    registry = ModelRegistry(factory, router,
+                             checkpoint_dir=cfg.checkpoint_dir,
+                             max_versions=cfg.serve_max_versions)
+    return registry, router, factory

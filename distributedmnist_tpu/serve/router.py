@@ -1,0 +1,332 @@
+"""Version-aware request router: the engine-shaped layer between the
+dynamic batcher and the per-version InferenceEngines.
+
+The batcher (serve/batcher.py) talks to ONE engine-shaped object. Before
+this layer that object was a single InferenceEngine, which froze the
+process on whatever params it started with. The Router keeps that exact
+surface — dispatch()/fetch(), max_batch/buckets/platform, _as_images —
+but resolves WHICH engine serves each batch at dispatch time:
+
+- **live**: the default target. set_live() swaps it atomically under a
+  lock the dispatch thread crosses once per batch; a handle captures its
+  engine at dispatch, so a batch dispatched on the old version fetches
+  from the old version even if the swap lands mid-flight. No request can
+  ever mix versions: routing is per-BATCH, and every row of a batch runs
+  one compiled program of one engine.
+- **canary**: a configured fraction of batches routes to a candidate FOR
+  REAL (clients get its results). Results are version-tagged end to end
+  (handle.version -> ServeMetrics.by_version), so the canary population's
+  latency/volume is separable from the live population's.
+- **shadow**: a sampled fraction of live batches is DUPLICATED to a
+  candidate. The client always gets the live result; the shadow result
+  is fetched on a dedicated drain thread (never the completion thread,
+  whose strict FIFO fan-out would let a slow candidate inflate live
+  p99), compared (argmax agreement + max abs logit diff, recorded in
+  metrics), and discarded. A shadow failure is recorded and swallowed —
+  a broken candidate must never break live traffic.
+
+Every engine a Router accepts must share its bucket ladder/max_batch
+(set_* assert it): a swap can therefore never introduce a new compile
+geometry, which is what keeps the zero-recompile contract true across
+swaps (Clockwork's rule: no model takes live traffic before its programs
+are compiled — enforced upstream by ModelRegistry, which only hands over
+pre-warmed engines).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import random
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from distributedmnist_tpu.serve.engine import InferenceEngine
+
+log = logging.getLogger("distributedmnist_tpu")
+
+
+class NoLiveModel(RuntimeError):
+    """dispatch() with no live version: the server is warming (or every
+    version was retired). 503 semantics, like batcher.Rejected."""
+
+    status = 503
+
+
+@dataclasses.dataclass
+class _Target:
+    engine: Any
+    version: str
+    fraction: float = 1.0
+
+
+@dataclasses.dataclass
+class RoutedHandle:
+    """A dispatched batch plus the engine that computed it (so fetch
+    lands on the right version regardless of swaps in between) and,
+    when shadowed, the duplicate in-flight on the candidate."""
+
+    handle: Any                   # the target engine's InferenceHandle
+    engine: Any
+    version: str
+    n: int
+    bucket: int
+    canary: bool = False
+    shadow_handle: Any = None
+    shadow_engine: Any = None
+    shadow_version: Optional[str] = None
+
+
+class Router:
+    """Engine-shaped dispatch()/fetch() over a swappable set of versioned
+    engines. Constructed from the shared engine geometry (max_batch /
+    buckets / platform / n_chips) so the batcher can be built and accept
+    requests BEFORE any version is live — early submits fail their
+    futures with NoLiveModel (503), they don't crash the pipeline."""
+
+    # Outstanding shadow duplications (dispatched or queued for
+    # comparison) are capped: past this, sampled batches SKIP the
+    # duplicate instead of growing the queue — a wedged candidate must
+    # cost bounded memory (each outstanding duplicate pins a staging
+    # buffer, a device batch and the live result), never an OOM.
+    SHADOW_CAP = 64
+
+    def __init__(self, max_batch: int, buckets: Sequence[int],
+                 platform: str, n_chips: int = 1, metrics=None,
+                 seed: int = 0, shadow_cap: Optional[int] = None):
+        self.max_batch = max_batch
+        self.buckets = tuple(buckets)
+        self.platform = platform
+        self.n_chips = n_chips
+        self.metrics = metrics
+        # `is None`, not `or`: an explicit 0 (duplicate nothing — every
+        # sampled batch counts as dropped) must be honored.
+        self.shadow_cap = (self.SHADOW_CAP if shadow_cap is None
+                           else shadow_cap)
+        self._lock = threading.Lock()
+        self._live: Optional[_Target] = None
+        self._canary: Optional[_Target] = None
+        self._shadow: Optional[_Target] = None
+        # Routing draws happen under the lock on the single dispatch
+        # thread; seeded so canary/shadow sampling is reproducible in
+        # tests and bench replays.
+        self._rng = random.Random(seed)
+        # Shadow comparisons drain on their own daemon thread: the
+        # completion thread resolves LIVE futures strictly FIFO, so a
+        # slow shadow candidate blocking inside fetch() would inflate
+        # live p99 for every batch queued behind it — exactly the
+        # "candidate must never hurt live traffic" violation shadow
+        # mode exists to prevent. Engine.fetch is thread-safe and
+        # order-independent (staging pool is locked), so out-of-order
+        # shadow fetches are fine.
+        self._shadow_q: queue.SimpleQueue = queue.SimpleQueue()
+        self._shadow_pending = 0
+        self._shadow_pending_lock = threading.Lock()
+        self._shadow_thread: Optional[threading.Thread] = None
+
+    # Engine-shape parity: borrow the engine's own implementations —
+    # both read only self.buckets / plain arrays, and a single copy
+    # cannot drift.
+    _as_images = staticmethod(InferenceEngine._as_images)
+    bucket_for = InferenceEngine.bucket_for
+
+    # -- version wiring (called by ModelRegistry / admin) -----------------
+
+    def _check_compatible(self, engine) -> None:
+        if (tuple(engine.buckets) != self.buckets
+                or engine.max_batch != self.max_batch):
+            raise ValueError(
+                "engine geometry mismatch: router serves buckets "
+                f"{self.buckets} (max_batch {self.max_batch}), engine has "
+                f"{tuple(engine.buckets)} (max_batch {engine.max_batch}) "
+                "— all versions must share one compile geometry")
+
+    def set_live(self, engine, version: str) -> None:
+        """Atomic hot-swap: the next dispatched batch runs `version`;
+        batches already in flight fetch from the engine their handle
+        captured. Clears a candidate role the promoted version held."""
+        self._check_compatible(engine)
+        with self._lock:
+            prev = self._live.version if self._live else None
+            self._live = _Target(engine, version)
+            if self._canary and self._canary.version == version:
+                self._canary = None
+            if self._shadow and self._shadow.version == version:
+                self._shadow = None
+        log.info("router: live version %s -> %s", prev, version)
+
+    def set_shadow(self, engine, version: str, fraction: float) -> None:
+        self._check_compatible(engine)
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"shadow fraction must be in (0, 1], "
+                             f"got {fraction}")
+        with self._lock:
+            self._shadow = _Target(engine, version, fraction)
+        log.info("router: shadowing %.0f%% of live traffic to %s",
+                 100 * fraction, version)
+
+    def set_canary(self, engine, version: str, fraction: float) -> None:
+        self._check_compatible(engine)
+        if not 0.0 < fraction < 1.0:
+            raise ValueError(f"canary fraction must be in (0, 1), "
+                             f"got {fraction}")
+        with self._lock:
+            self._canary = _Target(engine, version, fraction)
+        log.info("router: canarying %.0f%% of traffic to %s",
+                 100 * fraction, version)
+
+    def clear_candidates(self) -> None:
+        with self._lock:
+            self._canary = self._shadow = None
+
+    def live_version(self) -> Optional[str]:
+        with self._lock:
+            return self._live.version if self._live else None
+
+    def routes(self) -> dict:
+        """The current routing table (for GET /models and tests)."""
+        with self._lock:
+            return {
+                "live": self._live.version if self._live else None,
+                "canary": ({"version": self._canary.version,
+                            "fraction": self._canary.fraction}
+                           if self._canary else None),
+                "shadow": ({"version": self._shadow.version,
+                            "fraction": self._shadow.fraction}
+                           if self._shadow else None),
+            }
+
+    def versions_in_route(self) -> set:
+        """Versions currently holding a routing role (must not be
+        evicted from the registry)."""
+        with self._lock:
+            return {t.version for t in (self._live, self._canary,
+                                        self._shadow) if t is not None}
+
+    # -- the engine surface the batcher drives ----------------------------
+
+    def dispatch(self, x) -> RoutedHandle:
+        with self._lock:
+            live, canary, shadow = self._live, self._canary, self._shadow
+            route_draw = self._rng.random()
+            shadow_draw = self._rng.random()
+        if live is None:
+            raise NoLiveModel(
+                "no warmed model version is live (server warming?)")
+        target, is_canary = live, False
+        if canary is not None and route_draw < canary.fraction:
+            target, is_canary = canary, True
+        h = target.engine.dispatch(x)
+        rh = RoutedHandle(handle=h, engine=target.engine,
+                          version=target.version, n=h.n, bucket=h.bucket,
+                          canary=is_canary)
+        # Shadow only duplicates LIVE-routed batches: the canary and
+        # shadow populations stay disjoint, so their metrics are
+        # separately attributable.
+        if (shadow is not None and not is_canary
+                and shadow_draw < shadow.fraction):
+            # Claim an outstanding-duplication slot BEFORE dispatching:
+            # a wedged candidate stalls the drain thread, and unbounded
+            # duplication would pin a staging buffer + device batch +
+            # live result per entry until OOM. Past the cap the sample
+            # is skipped (dropped, counted) — live traffic never pays.
+            with self._shadow_pending_lock:
+                claim = self._shadow_pending < self.shadow_cap
+                if claim:
+                    self._shadow_pending += 1
+            if not claim:
+                if self.metrics is not None:
+                    self.metrics.record_shadow_drop()
+            else:
+                try:
+                    rh.shadow_handle = shadow.engine.dispatch(x)
+                    rh.shadow_engine = shadow.engine
+                    rh.shadow_version = shadow.version
+                except Exception:
+                    # A broken candidate must never take down live
+                    # traffic.
+                    log.exception("shadow dispatch to %s failed",
+                                  shadow.version)
+                    with self._shadow_pending_lock:
+                        self._shadow_pending -= 1
+                    if self.metrics is not None:
+                        self.metrics.record_shadow_error()
+        return rh
+
+    def fetch(self, rh: RoutedHandle) -> np.ndarray:
+        try:
+            out = rh.engine.fetch(rh.handle)
+        except Exception:
+            # The live fetch failing is the batcher's failure path; the
+            # shadow duplicate must still drain (its staging buffer and
+            # pending slot would leak otherwise). out=None skips the
+            # comparison.
+            if rh.shadow_handle is not None:
+                self._enqueue_shadow(rh, None)
+            raise
+        if rh.shadow_handle is not None:
+            # Hand the comparison to the drain thread and return the
+            # live result NOW: the completion thread must not wait out
+            # the candidate's compute before resolving live futures.
+            # (The pending slot was claimed at dispatch; released by
+            # the drain thread after the comparison lands.)
+            self._enqueue_shadow(rh, out)
+        # The client-facing result is ALWAYS the routed target's output;
+        # shadow results never leave the drain thread.
+        return out
+
+    def _enqueue_shadow(self, rh: RoutedHandle, out) -> None:
+        with self._shadow_pending_lock:
+            if self._shadow_thread is None:
+                self._shadow_thread = threading.Thread(
+                    target=self._shadow_loop, name="serve-shadow",
+                    daemon=True)
+                self._shadow_thread.start()
+        self._shadow_q.put((rh, out))
+
+    def _shadow_loop(self) -> None:
+        while True:
+            rh, out = self._shadow_q.get()
+            try:
+                shadow_out = rh.shadow_engine.fetch(rh.shadow_handle)
+                if self.metrics is not None and out is not None:
+                    agree = int(np.sum(out.argmax(-1)
+                                       == shadow_out.argmax(-1)))
+                    diff = float(np.max(np.abs(
+                        out.astype(np.float32)
+                        - shadow_out.astype(np.float32))))
+                    self.metrics.record_shadow(
+                        rh.version, rh.shadow_version, rows=rh.n,
+                        agree_rows=agree, max_abs_diff=diff)
+            except Exception:
+                log.exception("shadow fetch from %s failed",
+                              rh.shadow_version)
+                if self.metrics is not None:
+                    self.metrics.record_shadow_error()
+            finally:
+                with self._shadow_pending_lock:
+                    self._shadow_pending -= 1
+
+    def shadow_pending(self) -> int:
+        """Shadow comparisons enqueued but not yet recorded."""
+        with self._shadow_pending_lock:
+            return self._shadow_pending
+
+    def drain_shadow(self, timeout: float = 30.0) -> None:
+        """Bounded wait for all queued shadow comparisons to land in
+        metrics (tests and orderly shutdowns; live traffic never needs
+        this)."""
+        deadline = time.monotonic() + timeout
+        while self.shadow_pending():
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"{self.shadow_pending()} shadow comparison(s) "
+                    f"still pending after {timeout:g}s")
+            time.sleep(0.005)
+
+    def infer(self, x) -> np.ndarray:
+        return self.fetch(self.dispatch(x))

@@ -13,25 +13,47 @@ Two modes:
   {"metric": "serve_ready", "port": ...} JSON line on stdout). stdlib
   http.server only — the container installs nothing.
 
-    POST /predict   body = raw uint8 pixels, n*784 bytes ->
-                    {"classes": [...], "n": n}
-                    503 + Retry-After when the queue is past its
-                    backpressure watermark (shed, don't melt)
-    GET  /metrics   current ServeMetrics snapshot (JSON)
-    GET  /healthz   {"ok": true}
+    POST /predict        body = raw uint8 pixels, n*784 bytes ->
+                         {"classes": [...], "n": n, "version": ...}
+                         503 + Retry-After when the queue is past its
+                         backpressure watermark OR no warmed model is
+                         live yet (shed, don't melt)
+    GET  /metrics        current ServeMetrics snapshot (JSON), incl.
+                         per-version populations + shadow comparisons
+    GET  /healthz        real state: {"ok", "state":
+                         warming|running|draining, "live_version",
+                         "pending_rows", "inflight_batches",
+                         "versions"}; 503 until a warmed model is live
+    GET  /models         model registry listing + routing table
+    POST /models/load    {"dir"?: str, "version"?: str} — params-only
+                         restore of the latest committed checkpoint,
+                         pre-warm every bucket OFF the hot path; the
+                         new version becomes promotable, NOT live
+    POST /models/promote {"version": str, "mode"?: "live"|"shadow"|
+                         "canary", "fraction"?: float} — atomic
+                         hot-swap (live), or route a fraction as
+                         shadow (compare + discard) / canary (real)
+
+SIGHUP = load latest checkpoint from --checkpoint-dir and promote it
+(the operator's one-signal model roll). The server starts serving HTTP
+immediately in state "warming" (healthz 503, /predict 503) and flips to
+"running" only after the initial model has every bucket compiled — the
+Clockwork discipline: no traffic before the programs are warm.
 
 Periodic {"metric": "serve_stats", ...} heartbeat lines go to stdout
 (--metrics-every), so utils/supervise.py's json_record_acceptor can
 watch a serving process exactly as it watches the bench. SIGTERM/SIGINT
+flip state to "draining" (healthz 503 — load balancers stop sending),
 shut the server down cleanly and print a final summary line.
 
 Model/params come from Config: --checkpoint-dir restores trained params
-(the usual serving case); otherwise params are fresh-init (load tests).
-Batching knobs: --serve-max-batch, --serve-max-wait-us,
---serve-queue-depth, --serve-max-inflight (config.py). --request-timeout
-bounds how long an HTTP client thread may wait on its future before a
-504 — a wedged dispatch pipeline must shed its waiters, not hold
-ThreadingHTTPServer threads forever.
+(params-only — no optimizer slots are read for serving); otherwise
+params are fresh-init (load tests). Batching knobs: --serve-max-batch,
+--serve-max-wait-us, --serve-queue-depth, --serve-max-inflight
+(config.py); --serve-max-versions bounds resident warmed versions.
+--request-timeout bounds how long an HTTP client thread may wait on its
+future before a 504 — a wedged dispatch pipeline must shed its waiters,
+not hold ThreadingHTTPServer threads forever.
 """
 
 from __future__ import annotations
@@ -47,6 +69,62 @@ import time
 from distributedmnist_tpu import config as config_lib
 
 IMAGE_BYTES = 28 * 28
+
+log = logging.getLogger("distributedmnist_tpu")
+
+
+class ServerState:
+    """The serving process's lifecycle phase, reported by /healthz.
+    warming -> running -> draining; "failed" when the initial model
+    load/warm died (the server stays up so healthz can say WHY it is
+    unhealthy instead of connection-refused). All transitions go through
+    the locked methods: draining is TERMINAL, and a check-then-set from
+    an unsynchronized handler thread must never resurrect a
+    shutting-down server to "running"."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.phase = "warming"
+
+    def mark_running(self) -> None:
+        """warming/failed -> running (no-op from draining)."""
+        with self._lock:
+            if self.phase in ("warming", "failed"):
+                self.phase = "running"
+
+    def mark_failed(self) -> None:
+        """warming -> failed (no-op once running or draining)."""
+        with self._lock:
+            if self.phase == "warming":
+                self.phase = "failed"
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self.phase = "draining"
+
+    def healthz(self, registry, batcher) -> tuple[int, dict]:
+        live = registry.live_version()
+        # Recovery is observable, not sticky: a warmed model going live
+        # through ANY path (initial warm thread, admin load+promote,
+        # SIGHUP) flips warming/failed -> running — an operator who
+        # repairs a bad boot checkpoint via the admin API must not be
+        # left permanently 503. Draining stays terminal (mark_running
+        # refuses it under the lock, so a SIGTERM racing this poll can
+        # never be clobbered back to 200).
+        if live is not None:
+            self.mark_running()
+        with self._lock:
+            phase = self.phase
+        ok = phase == "running" and live is not None
+        payload = {
+            "ok": ok,
+            "state": phase,
+            "live_version": live,
+            "pending_rows": batcher.pending_rows(),
+            "inflight_batches": batcher.inflight_batches(),
+            "versions": len(registry.describe()["versions"]),
+        }
+        return (200 if ok else 503), payload
 
 
 def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
@@ -72,14 +150,20 @@ def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
             "rejected_at_submit": rejected, **metrics.snapshot()}
 
 
-def _http_serve(batcher, metrics, engine, port: int,
-                metrics_every: float, request_timeout: float) -> dict:
+def _http_serve(batcher, metrics, registry, state, port: int,
+                metrics_every: float, request_timeout: float,
+                warm) -> dict:
     import concurrent.futures
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-    from distributedmnist_tpu.serve import Rejected
+    from distributedmnist_tpu.serve import NoLiveModel, Rejected
 
-    max_body = engine.max_batch * IMAGE_BYTES
+    max_body = registry.factory.max_batch * IMAGE_BYTES
+    # Serializes admin mutations from HTTP/SIGHUP threads so two
+    # concurrent loads can't interleave their registry side effects
+    # mid-request (the registry's own lock already protects state; this
+    # one keeps *responses* coherent, e.g. load-then-promote scripts).
+    admin_lock = threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -98,25 +182,120 @@ def _http_serve(batcher, metrics, engine, port: int,
             self.end_headers()
             self.wfile.write(body)
 
+        def _json_body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            if length == 0:
+                return {}
+            raw = self.rfile.read(length)
+            body = json.loads(raw) if raw.strip() else {}
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            return body
+
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"ok": True})
+                code, payload = state.healthz(registry, batcher)
+                self._send(code, payload)
             elif self.path == "/metrics":
                 self._send(200, metrics.record())
+            elif self.path == "/models":
+                self._send(200, registry.describe())
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):
-            if self.path != "/predict":
+            if self.path == "/predict":
+                self._predict()
+            elif self.path == "/models/load":
+                self._models_load()
+            elif self.path == "/models/promote":
+                self._models_promote()
+            else:
                 self._send(404, {"error": f"unknown path {self.path}"})
+
+        # -- admin: model lifecycle -----------------------------------
+
+        def _models_load(self):
+            try:
+                body = self._json_body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad JSON body: {e}"})
                 return
+            try:
+                # Load + pre-warm runs on THIS handler thread — the
+                # dispatch thread keeps serving the live version
+                # throughout (warmup is off the hot path by
+                # construction).
+                with admin_lock:
+                    mv = registry.load_latest(
+                        directory=body.get("dir"),
+                        version=body.get("version"))
+                self._send(200, mv.describe())
+            except FileNotFoundError as e:
+                self._send(404, {"error": str(e)})
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except RuntimeError as e:
+                # lifecycle conflict (e.g. registry full of route-
+                # holding versions): client-resolvable, same 409
+                # semantics as promote's rule refusals
+                self._send(409, {"error": str(e)})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _models_promote(self):
+            try:
+                body = self._json_body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            version = body.get("version")
+            mode = body.get("mode", "live")
+            if not version:
+                self._send(400, {"error": "missing 'version'"})
+                return
+            if mode not in ("live", "shadow", "canary"):
+                self._send(400, {"error": f"unknown mode {mode!r}"})
+                return
+            # Malformed input is a 400 like the checks above — decided
+            # BEFORE the lifecycle try block, whose ValueError arm means
+            # "valid request, rules refused it" (409).
+            try:
+                fraction = float(body.get("fraction", 0.1))
+            except (TypeError, ValueError):
+                self._send(400, {"error": "'fraction' must be a number, "
+                                          f"got {body.get('fraction')!r}"})
+                return
+            try:
+                with admin_lock:
+                    if mode == "live":
+                        mv = registry.promote(version)
+                    elif mode == "shadow":
+                        mv = registry.set_shadow(version, fraction)
+                    else:
+                        mv = registry.set_canary(version, fraction)
+                self._send(200, {"promoted": mv.version, "mode": mode,
+                                 **registry.describe()["routes"]})
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+            except (ValueError, RuntimeError) as e:
+                # un-warmed version / bad fraction: a conflict with the
+                # lifecycle rules, not a server fault
+                self._send(409, {"error": str(e)})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        # -- data path -------------------------------------------------
+
+        def _predict(self):
             length = int(self.headers.get("Content-Length", 0))
             if length == 0 or length % IMAGE_BYTES:
                 self._send(400, {"error": "body must be n*784 raw "
                                           "uint8 pixel bytes"})
                 return
             if length > max_body:
-                self._send(413, {"error": f"at most {engine.max_batch} "
+                self._send(413, {"error": f"at most "
+                                          f"{registry.factory.max_batch} "
                                           "images per request"})
                 return
             import numpy as np
@@ -127,9 +306,17 @@ def _http_serve(batcher, metrics, engine, port: int,
                 # handler thread must come back (504) rather than be
                 # held forever — ThreadingHTTPServer has no thread cap,
                 # so unbounded waiters pile up until exhaustion.
-                logits = batcher.submit(x).result(timeout=request_timeout)
+                fut = batcher.submit(x)
+                logits = fut.result(timeout=request_timeout)
             except Rejected:
                 self._send(503, {"error": "overloaded; retry"},
+                           extra={"Retry-After": "1"})
+                return
+            except NoLiveModel:
+                # still warming (or drained of versions): same shed
+                # semantics as overload — the client should retry, and
+                # /healthz says why
+                self._send(503, {"error": "no warmed model is live yet"},
                            extra={"Retry-After": "1"})
                 return
             except concurrent.futures.TimeoutError:
@@ -140,13 +327,35 @@ def _http_serve(batcher, metrics, engine, port: int,
                 # an HTTP error beats a dropped keep-alive connection
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
+            # The version that COMPUTED this batch (tagged onto the
+            # future by the completion thread) — under canary routing
+            # that is not necessarily the live version.
             self._send(200, {"classes": logits.argmax(-1).tolist(),
-                             "n": int(x.shape[0])})
+                             "n": int(x.shape[0]),
+                             "version": getattr(fut, "version", None)})
 
     srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     bound = srv.server_address[1]
+    # Announce the port FIRST, then warm: /healthz is pollable (and
+    # honestly 503) during model warmup, flipping to 200 only once the
+    # initial version is live with every bucket compiled.
     print(json.dumps({"metric": "serve_ready", "port": bound}),
           flush=True)
+
+    def _warm():
+        try:
+            warm()
+            # draining is terminal: a SIGTERM that landed mid-warmup
+            # must not be clobbered back to "running" by this thread —
+            # the load balancer already saw 503 and moved on.
+            state.mark_running()
+        except Exception:
+            state.mark_failed()
+            log.exception("initial model load/warm failed; serving "
+                          "503s until an admin load succeeds")
+
+    threading.Thread(target=_warm, name="serve-warm",
+                     daemon=True).start()
 
     stop = threading.Event()
 
@@ -158,17 +367,39 @@ def _http_serve(batcher, metrics, engine, port: int,
     beat.start()
 
     def _shutdown(signum, frame):
-        # shutdown() must come from another thread than serve_forever()
+        # draining: healthz flips 503 so load balancers stop routing
+        # here while in-flight work finishes; shutdown() must come from
+        # another thread than serve_forever()
+        state.begin_drain()
         threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    def _reload(signum, frame):
+        # SIGHUP = roll the model: params-only restore of the latest
+        # committed checkpoint, pre-warm, atomic promote. Runs on its
+        # own thread — signal handlers must not block on a warmup.
+        def run():
+            try:
+                with admin_lock:
+                    mv = registry.load_latest()
+                    registry.promote(mv.version)
+                log.info("SIGHUP reload: %s is live", mv.version)
+            except Exception:
+                log.exception("SIGHUP reload failed; live version "
+                              "unchanged")
+
+        threading.Thread(target=run, name="serve-reload",
+                         daemon=True).start()
 
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
+    signal.signal(signal.SIGHUP, _reload)
     try:
         srv.serve_forever(poll_interval=0.2)
     finally:
         stop.set()
         srv.server_close()
     return {"metric": "serve_summary", "port": bound,
+            "live_version": registry.live_version(),
             **metrics.snapshot()}
 
 
@@ -199,33 +430,42 @@ def main(argv=None) -> int:
         p.error("--request-timeout must be > 0")
     if args.serve_max_inflight is not None and args.serve_max_inflight < 1:
         p.error("--serve-max-inflight must be >= 1")
+    if args.serve_max_versions is not None and args.serve_max_versions < 2:
+        p.error("--serve-max-versions must be >= 2 (live + a candidate)")
     cfg = config_lib.from_args(args)
 
     from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
-                                            build_engine)
+                                            build_serving)
 
-    engine = build_engine(cfg)
-    t0 = time.perf_counter()
-    engine.warmup()
-    logging.getLogger("distributedmnist_tpu").info(
-        "buckets %s warm in %.2fs", list(engine.buckets),
-        time.perf_counter() - t0)
     metrics = ServeMetrics()
-    batcher = DynamicBatcher(engine, max_batch=cfg.serve_max_batch,
+    registry, router, factory = build_serving(cfg, metrics=metrics)
+    batcher = DynamicBatcher(router, max_batch=cfg.serve_max_batch,
                              max_wait_us=cfg.serve_max_wait_us,
                              queue_depth=cfg.serve_queue_depth,
                              max_inflight=cfg.serve_max_inflight,
                              metrics=metrics).start()
-    logging.getLogger("distributedmnist_tpu").info(
-        "dispatch pipeline depth: %d", batcher.max_inflight)
+    log.info("dispatch pipeline depth: %d; buckets %s",
+             batcher.max_inflight, list(factory.buckets))
+    state = ServerState()
+
+    def warm():
+        t0 = time.perf_counter()
+        mv = registry.bootstrap(seed=cfg.seed)
+        log.info("bootstrap %s (%s) warmed in %.2fs — %d compile "
+                 "events; live: %s", mv.version, mv.source,
+                 time.perf_counter() - t0, mv.warmup_compile_events,
+                 registry.live_version())
+
     try:
         if args.port is None:
+            warm()                       # synchronous: the gate is cheap
+            state.mark_running()
             summary = _selftest(batcher, metrics, args.selftest or 256,
-                                engine.max_batch)
+                                factory.max_batch)
         else:
-            summary = _http_serve(batcher, metrics, engine, args.port,
-                                  args.metrics_every,
-                                  args.request_timeout)
+            summary = _http_serve(batcher, metrics, registry, state,
+                                  args.port, args.metrics_every,
+                                  args.request_timeout, warm)
     finally:
         batcher.stop()
     print(json.dumps(summary), flush=True)

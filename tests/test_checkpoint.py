@@ -277,3 +277,84 @@ def test_eval_only_restores_and_reports(tmp_path, tiny_data):
         trainer.fit(cfg.replace(eval_only=True,
                                 checkpoint_dir=str(tmp_path / "none")),
                     data=tiny_data)
+
+
+# -- params-only serving restore (checkpoint.restore_latest_params) -------
+
+
+def _abstract_params(state):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding), state.params)
+
+
+def test_restore_latest_params_matches_saved(tmp_path, eight_devices):
+    """The serving path reads ONLY the params subtree of the latest
+    committed checkpoint: values bit-match the saved params, the step is
+    reported, and the result lands with the requested sharding."""
+    from distributedmnist_tpu.checkpoint import restore_latest_params
+
+    ckpt = Checkpointer(str(tmp_path / "c"), async_save=False)
+    states = {}
+    for step in (3, 11):
+        states[step] = _state(eight_devices, step=step)
+        ckpt.save(step, states[step])
+    ckpt.wait()
+    ckpt.close()
+
+    params, step = restore_latest_params(str(tmp_path / "c"),
+                                         _abstract_params(states[11]))
+    assert step == 11
+    _assert_tree_equal(params, states[11].params)
+    leaf = jax.tree.leaves(params)[0]
+    assert len(leaf.sharding.device_set) == 8
+
+
+def test_restore_latest_params_empty_dir_is_none(tmp_path, eight_devices):
+    from distributedmnist_tpu.checkpoint import restore_latest_params
+
+    params, step = restore_latest_params(
+        str(tmp_path / "nothing"),
+        _abstract_params(_state(eight_devices)))
+    assert params is None and step is None
+
+
+def test_restore_latest_params_ignores_optimizer_layout(tmp_path,
+                                                        eight_devices):
+    """maybe_restore needs flat<->per-leaf conversion machinery; the
+    params-only path must not — the opt_state subtree is skipped, so
+    either layout (and either optimizer) serves identically."""
+    from distributedmnist_tpu.checkpoint import restore_latest_params
+
+    abstract = None
+    for flat, sub in ((True, "flat"), (False, "perleaf")):
+        state = _state(eight_devices, step=2, flat=flat)
+        abstract = abstract or _abstract_params(state)
+        ckpt = Checkpointer(str(tmp_path / sub), async_save=False)
+        ckpt.save(2, state)
+        ckpt.wait()
+        ckpt.close()
+        params, step = restore_latest_params(str(tmp_path / sub), abstract)
+        assert step == 2, sub
+        _assert_tree_equal(params, state.params)
+
+
+def test_restore_latest_params_wrong_model_raises(tmp_path, eight_devices):
+    """A checkpoint whose params tree doesn't match the serving model's
+    structure fails loudly, naming the directory."""
+    from distributedmnist_tpu.checkpoint import restore_latest_params
+
+    ckpt = Checkpointer(str(tmp_path / "c"), async_save=False)
+    ckpt.save(1, _state(eight_devices))          # an MLP checkpoint
+    ckpt.wait()
+    ckpt.close()
+
+    mesh = make_mesh(eight_devices)
+    lenet = models.build("lenet", conv="lax")
+    lenet_state = jax.device_put(
+        init_state(jax.random.PRNGKey(0), lenet,
+                   optim.build("adam", 1e-3),
+                   jnp.zeros((1, 28, 28, 1))), replicated(mesh))
+    with pytest.raises(ValueError, match="params"):
+        restore_latest_params(str(tmp_path / "c"),
+                              _abstract_params(lenet_state))

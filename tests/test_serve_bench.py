@@ -53,6 +53,15 @@ def test_bench_serve_contract():
     # steady state after bucket warmup must be recompile-free
     assert d["warmup_compile_events"] > 0
     assert d["recompiles_after_warmup"] == 0
+    # provenance: the artifact must be self-locating (a CPU-host number
+    # can never be conflated with a TPU headline)
+    host = d["host"]
+    assert host["backend"] == d["backend"]
+    assert host["chip_count"] == d["n_chips"]
+    assert host["device_kind"] and host["hostname"] and host["platform"]
+    assert d["swap"] is None               # not requested in this run
+    assert d["params"] == "fresh-init"
+    assert d["live_version_final"]
     assert d["max_inflight"] == 4          # the bench's pipelined default
     closed = d["closed_loop"]
     for q in ("p50", "p95", "p99"):
@@ -116,6 +125,9 @@ def test_bench_training_modes_reject_serve_flags():
     out = _run_cli("bench.py", ["smoke", "--serve-clients", "4"],
                    timeout=60)
     assert out.returncode == 2
+    out = _run_cli("bench.py", ["throughput", "--swap-during-load"],
+                   timeout=60)
+    assert out.returncode == 2
 
 
 def test_bench_positional_mode_conflict_rejected():
@@ -153,52 +165,92 @@ def test_serve_selftest_contract():
     assert rec["batch_occupancy"]
 
 
+def _start_server(repo, env, extra=()):
+    """Launch serve.py --port 0, return (proc, port) once the port is
+    announced. The server is still WARMING at that point — /healthz is
+    503 until the initial model has every bucket compiled."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "serve.py"), "--model", "mlp",
+         "--device", "cpu", "--serve-max-batch", "16", "--port", "0",
+         "--metrics-every", "0.5"] + list(extra),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=repo)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        assert line, "serve.py exited before announcing readiness"
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "serve_ready":
+            return proc, rec["port"]
+    pytest.fail("no serve_ready line")
+
+
+def _get_json(url, timeout=10):
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+def _post_json(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def _wait_healthy(base, timeout=120) -> dict:
+    """Poll /healthz until it flips to 200 (warmup complete); returns
+    the healthy payload."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return _get_json(f"{base}/healthz")
+        except urllib.error.HTTPError as e:
+            # 503 while warming IS the contract — keep polling
+            last = json.loads(e.read())
+            assert e.code == 503, e.code
+            assert last["ok"] is False
+            time.sleep(0.1)
+    pytest.fail(f"/healthz never became healthy: {last}")
+
+
 def test_serve_http_end_to_end():
-    """serve.py --port 0: ready announcement, POST /predict, /metrics
+    """serve.py --port 0: ready announcement, /healthz 503-while-warming
+    then a real state payload, POST /predict (version-tagged), /metrics
     heartbeat shape, 400 on a malformed body, SIGTERM -> clean summary.
     The metrics lines carry the conventional 'metric' key, so a
     supervise.json_record_acceptor sees a serving process as alive."""
     env, repo = worker_env()
-    proc = subprocess.Popen(
-        [sys.executable, os.path.join(repo, "serve.py"), "--model", "mlp",
-         "--device", "cpu", "--serve-max-batch", "16", "--port", "0",
-         "--metrics-every", "0.5"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        env=env, cwd=repo)
-    port = None
+    proc, port = _start_server(repo, env)
     try:
-        deadline = time.monotonic() + 120
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            assert line, "serve.py exited before announcing readiness"
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("metric") == "serve_ready":
-                port = rec["port"]
-                break
-        assert port, "no serve_ready line"
         base = f"http://127.0.0.1:{port}"
+        # healthz flips 503 -> 200 only once warmup completes, and then
+        # reports REAL state, not a hardcoded ok
+        ok = _wait_healthy(base)
+        assert ok["ok"] is True and ok["state"] == "running"
+        assert ok["live_version"]
+        assert isinstance(ok["pending_rows"], int)
+        assert isinstance(ok["inflight_batches"], int)
+        assert ok["versions"] >= 1
 
         body = np.full((3, 784), 128, np.uint8).tobytes()
         r = json.loads(urllib.request.urlopen(
             f"{base}/predict", data=body, timeout=30).read())
         assert r["n"] == 3 and len(r["classes"]) == 3
         assert all(0 <= c <= 9 for c in r["classes"])
+        assert r["version"] == ok["live_version"]
 
         m = json.loads(urllib.request.urlopen(
             f"{base}/metrics", timeout=10).read())
         assert m["metric"] == "serve_stats" and m["requests"] >= 1
+        assert r["version"] in m["by_version"]
 
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(f"{base}/predict", data=b"not-784",
                                    timeout=10)
         assert ei.value.code == 400
-
-        ok = json.loads(urllib.request.urlopen(
-            f"{base}/healthz", timeout=10).read())
-        assert ok == {"ok": True}
     finally:
         proc.send_signal(signal.SIGTERM)
         try:
@@ -210,3 +262,180 @@ def test_serve_http_end_to_end():
     records = [json.loads(l) for l in out.splitlines() if l.strip()]
     summary = [r for r in records if r.get("metric") == "serve_summary"]
     assert summary and summary[-1]["requests"] >= 1
+
+
+def _save_mlp_checkpoint(ckpt_dir: str, step: int, seed: int = 3) -> None:
+    """Commit a full-train-state checkpoint the serving process can
+    roll to (the admin/SIGHUP tests' 'a trainer finished' stand-in)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import models, optim
+    from distributedmnist_tpu.checkpoint import Checkpointer
+    from distributedmnist_tpu.parallel import make_mesh, replicated
+    from distributedmnist_tpu.trainer import init_state
+
+    mesh = make_mesh(jax.devices()[:8])
+    model = models.build("mlp", fused="xla")
+    state = init_state(jax.random.PRNGKey(seed), model,
+                       optim.build("adam", 1e-3),
+                       jnp.zeros((1, 28, 28, 1)))
+    state = state.replace(step=jnp.asarray(step, jnp.int32))
+    state = jax.device_put(state, replicated(mesh))
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    ckpt.save(step, state)
+    ckpt.wait()
+    ckpt.close()
+
+
+def test_serve_admin_model_lifecycle(tmp_path):
+    """The model-lifecycle admin surface end-to-end over HTTP: boot
+    fresh-init (empty checkpoint dir), load a newly committed checkpoint
+    via POST /models/load (params-only restore + pre-warm, live traffic
+    unaffected), promote it atomically, roll again via SIGHUP, and put
+    the demoted version back in play as a canary."""
+    ckpt_dir = str(tmp_path / "ck")
+    env, repo = worker_env()
+    proc, port = _start_server(repo, env,
+                               extra=["--checkpoint-dir", ckpt_dir])
+    try:
+        base = f"http://127.0.0.1:{port}"
+        boot = _wait_healthy(base)["live_version"]
+
+        models_view = _get_json(f"{base}/models")
+        assert models_view["routes"]["live"] == boot
+        assert [v["version"] for v in models_view["versions"]] == [boot]
+        assert models_view["versions"][0]["source"] == "fresh-init"
+
+        # roll 1: explicit admin load + promote
+        _save_mlp_checkpoint(ckpt_dir, step=5)
+        loaded = _post_json(f"{base}/models/load", {})
+        assert loaded["version"] == "step-5"
+        assert loaded["state"] == "ready"       # promotable, NOT live
+        assert loaded["warmup_compile_events"] > 0
+        assert _get_json(f"{base}/models")["routes"]["live"] == boot
+
+        promoted = _post_json(f"{base}/models/promote",
+                              {"version": "step-5"})
+        assert promoted["live"] == "step-5"
+        body = np.full((2, 784), 7, np.uint8).tobytes()
+        r = json.loads(urllib.request.urlopen(
+            f"{base}/predict", data=body, timeout=30).read())
+        assert r["version"] == "step-5"
+
+        # promote of an unknown version is a 404, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(f"{base}/models/promote", {"version": "nope"})
+        assert ei.value.code == 404
+        # malformed fraction is a client error (400), not a lifecycle
+        # conflict (409) or a server fault (500)
+        for bad in ("lots", None):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_json(f"{base}/models/promote",
+                           {"version": boot, "mode": "canary",
+                            "fraction": bad})
+            assert ei.value.code == 400, bad
+
+        # roll 2: SIGHUP = load latest from --checkpoint-dir + promote
+        _save_mlp_checkpoint(ckpt_dir, step=9, seed=4)
+        proc.send_signal(signal.SIGHUP)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if _get_json(f"{base}/models")["routes"]["live"] == "step-9":
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("SIGHUP reload never promoted step-9")
+
+        # the demoted version is still resident: stage it as a canary
+        canary = _post_json(f"{base}/models/promote",
+                            {"version": "step-5", "mode": "canary",
+                             "fraction": 0.25})
+        assert canary["canary"] == {"version": "step-5",
+                                    "fraction": 0.25}
+        assert _get_json(f"{base}/healthz")["live_version"] == "step-9"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert proc.returncode == 0
+
+
+def test_healthz_state_machine_recovers_from_failed_boot():
+    """ServerState.healthz: 503 while warming, 200 once ANY path puts a
+    live version up (including recovery after a failed boot via admin
+    load+promote), and draining is terminal 503 — a repaired server
+    must not stay unroutable forever."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_mod", os.path.join(worker_env()[1], "serve.py"))
+    serve_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_mod)
+
+    class StubRegistry:
+        live = None
+
+        def live_version(self):
+            return self.live
+
+        def describe(self):
+            return {"versions": [1] if self.live else []}
+
+    class StubBatcher:
+        def pending_rows(self):
+            return 0
+
+        def inflight_batches(self):
+            return 0
+
+    state = serve_mod.ServerState()
+    reg, b = StubRegistry(), StubBatcher()
+    code, payload = state.healthz(reg, b)
+    assert code == 503 and payload["state"] == "warming"
+
+    state.phase = "failed"                      # boot load died
+    code, _ = state.healthz(reg, b)
+    assert code == 503
+    reg.live = "step-5"                         # admin repaired it
+    code, payload = state.healthz(reg, b)
+    assert code == 200 and payload["state"] == "running"
+    assert payload["live_version"] == "step-5"
+
+    state.begin_drain()                         # SIGTERM: terminal
+    code, payload = state.healthz(reg, b)
+    assert code == 503 and payload["state"] == "draining"
+    # draining can never be resurrected — not by the warm thread, not
+    # by a healthz poll that sees a live version
+    state.mark_running()
+    code, payload = state.healthz(reg, b)
+    assert code == 503 and payload["state"] == "draining"
+
+
+def test_bench_serve_swap_during_load():
+    """`bench.py serve --swap-during-load`: the record carries the swap
+    block — a real mid-window load + pre-warm + promote with ZERO
+    recompiles after the candidate's warmup, and the swap-window p99
+    measured against the steady-state p99."""
+    out = _run_cli("bench.py", ["serve", "--swap-during-load"]
+                   + SERVE_ARGS)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip())
+    d = rec["detail"]
+    swap = d["swap"]
+    assert swap["version"] == "v-swap"
+    assert swap["warmup_compile_events"] > 0     # candidate DID compile,
+    assert swap["recompiles_after_swap"] == 0    # but off the hot path
+    assert d["recompiles_after_warmup"] == 0     # whole-run discipline
+    assert swap["swap_window_p99_ms"] is not None
+    assert swap["load_warm_s"] > 0
+    # both versions took traffic inside the swap window
+    assert set(swap["swap_window"]["by_version"]) == {"v1", "v-swap"}
+    assert d["live_version_final"] == "v-swap"
+    # the decomposed post-promote tail (the pure new-version population)
+    # is reported alongside the whole-window ratio
+    assert swap["post_swap_p99_ms"] is not None
+    assert swap["post_swap_p99_ratio_vs_steady"] is not None

@@ -1,0 +1,313 @@
+"""serve/registry.py: version lifecycle (load -> pre-warm -> promote ->
+rollback), the params-only checkpoint path, the Clockwork promote gate
+(only warmed versions take traffic), residency eviction, and the
+zero-recompile contract ACROSS a hot-swap with real engines."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedmnist_tpu import models, optim
+from distributedmnist_tpu.checkpoint import Checkpointer
+from distributedmnist_tpu.parallel import make_mesh, replicated
+from distributedmnist_tpu.serve import (DynamicBatcher, EngineFactory,
+                                        ModelRegistry, ServeMetrics)
+from distributedmnist_tpu.trainer import init_state
+from distributedmnist_tpu.utils import CompileCounter
+
+
+@pytest.fixture()
+def factory(eight_devices):
+    mesh = make_mesh(eight_devices)
+    model = models.build("mlp", platform="cpu")
+    return EngineFactory(model, mesh, max_batch=16)
+
+
+def _registry(factory, metrics=None, **kw):
+    router = factory.make_router(metrics=metrics)
+    return ModelRegistry(factory, router, **kw), router
+
+
+def _trained_state(factory, seed=9, step=7):
+    tx = optim.build("adam", 1e-3, flat=True)
+    state = init_state(jax.random.PRNGKey(seed), factory.model, tx,
+                       jnp.zeros((1, 28, 28, 1)))
+    state = state.replace(step=jnp.asarray(step, jnp.int32))
+    return jax.device_put(state, replicated(factory.mesh))
+
+
+def test_add_prewarms_and_promote_goes_live(factory, rng):
+    registry, router = _registry(factory)
+    assert registry.live_version() is None
+    mv = registry.add(factory.init_params(0), source="fresh-init")
+    assert mv.state == "ready" and mv.version == "v1"
+    # pre-warm really compiled every bucket: a fresh engine costs
+    # compile events, and the registry's verification pass proved a
+    # second sweep costs zero
+    assert mv.warmup_compile_events >= len(factory.buckets)
+    registry.promote("v1")
+    assert registry.get("v1").state == "live"
+    assert router.live_version() == "v1"
+    x = rng.integers(0, 256, (5, 784)).astype(np.uint8)
+    assert router.infer(x).shape == (5, 10)
+
+
+def test_promote_refuses_unwarmed_version(factory):
+    registry, _ = _registry(factory)
+    mv = registry.add(factory.init_params(0), version="cold")
+    mv.state = "warming"          # simulate a still-warming candidate
+    with pytest.raises(RuntimeError, match="warmed"):
+        registry.promote("cold")
+    with pytest.raises(KeyError, match="unknown version"):
+        registry.promote("never-loaded")
+
+
+def test_load_latest_is_params_only_and_correct(factory, tmp_path, rng):
+    """A checkpoint written with FULL train state (params + optimizer
+    slots) serves through the params-only restore: the loaded version's
+    logits match the saved params' direct forward exactly, and the
+    version is named after the checkpoint step."""
+    state = _trained_state(factory, seed=9, step=7)
+    ckpt = Checkpointer(str(tmp_path / "c"), async_save=False)
+    ckpt.save(7, state)
+    ckpt.wait()
+    ckpt.close()
+
+    registry, router = _registry(factory)
+    mv = registry.load_latest(str(tmp_path / "c"))
+    assert mv.version == "step-7" and mv.step == 7
+    assert mv.state == "ready"
+    registry.promote(mv.version)
+
+    x = rng.integers(0, 256, (4, 28, 28, 1)).astype(np.uint8)
+    got = router.infer(x)
+    ref = factory.model.apply({"params": jax.device_get(state.params)},
+                              x.astype(np.float32) / 255.0)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_load_latest_layout_agnostic(factory, tmp_path):
+    """Serving restore must not care which optimizer-state layout the
+    checkpoint was written under (config.flat_optimizer): params-only
+    means the opt_state subtree is never even read."""
+    for flat, sub in ((True, "flat"), (False, "perleaf")):
+        tx = optim.build("adam", 1e-3, flat=flat)
+        state = init_state(jax.random.PRNGKey(3), factory.model, tx,
+                           jnp.zeros((1, 28, 28, 1)))
+        state = jax.device_put(state, replicated(factory.mesh))
+        ckpt = Checkpointer(str(tmp_path / sub), async_save=False)
+        ckpt.save(1, state)
+        ckpt.wait()
+        ckpt.close()
+        registry, _ = _registry(factory)
+        mv = registry.load_latest(str(tmp_path / sub))
+        assert mv.state == "ready", sub
+
+
+def test_load_latest_no_checkpoint_raises(factory, tmp_path):
+    registry, _ = _registry(factory)
+    with pytest.raises(FileNotFoundError, match="no committed"):
+        registry.load_latest(str(tmp_path / "empty"))
+    with pytest.raises(ValueError, match="checkpoint directory"):
+        registry.load_latest()    # no dir configured at all
+
+
+def test_load_latest_is_idempotent_per_step(factory, tmp_path,
+                                            monkeypatch):
+    """SIGHUP can fire repeatedly: re-loading an already resident step
+    returns the existing version instead of warming a duplicate — and
+    without re-reading the checkpoint bytes (the residency check runs
+    BEFORE the restore, so a no-new-checkpoint reload costs a
+    listdir)."""
+    from distributedmnist_tpu import checkpoint as ckpt_mod
+
+    state = _trained_state(factory, step=5)
+    ckpt = Checkpointer(str(tmp_path / "c"), async_save=False)
+    ckpt.save(5, state)
+    ckpt.wait()
+    ckpt.close()
+    registry, _ = _registry(factory)
+    calls = []
+    real = ckpt_mod.restore_latest_params
+    monkeypatch.setattr(ckpt_mod, "restore_latest_params",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    mv1 = registry.load_latest(str(tmp_path / "c"))
+    mv2 = registry.load_latest(str(tmp_path / "c"))
+    assert mv1 is mv2
+    assert len(registry.describe()["versions"]) == 1
+    assert len(calls) == 1, "redundant reload re-read the checkpoint"
+
+
+def test_load_latest_explicit_name_refuses_stale_step(factory,
+                                                      tmp_path):
+    """An explicit version name loaded at step N must not silently
+    short-circuit once a newer step is committed: returning the stale
+    entry as if freshly loaded would let an operator promote old
+    params believing them latest."""
+    ckpt = Checkpointer(str(tmp_path / "c"), async_save=False)
+    ckpt.save(5, _trained_state(factory, step=5))
+    ckpt.wait()
+    registry, _ = _registry(factory)
+    mv = registry.load_latest(str(tmp_path / "c"), version="candidate")
+    assert mv.step == 5
+    # same step: idempotent
+    assert registry.load_latest(str(tmp_path / "c"),
+                                version="candidate") is mv
+    ckpt.save(9, _trained_state(factory, step=9))
+    ckpt.wait()
+    ckpt.close()
+    with pytest.raises(ValueError, match="already holds step 5"):
+        registry.load_latest(str(tmp_path / "c"), version="candidate")
+    # the step-derived default name still loads the new checkpoint
+    assert registry.load_latest(str(tmp_path / "c")).step == 9
+
+
+def test_bootstrap_fresh_init_without_checkpoint(factory):
+    registry, router = _registry(factory)
+    mv = registry.bootstrap(seed=0)
+    assert mv.source == "fresh-init"
+    assert registry.live_version() == mv.version
+    assert router.routes()["live"] == mv.version
+
+
+def test_bootstrap_yields_to_a_version_already_live(factory):
+    """If an admin promotion landed while the boot version warmed (the
+    SIGHUP-races-boot case), bootstrap must NOT steal live back for its
+    own — possibly fresh-init — params; the operator's choice wins."""
+    registry, router = _registry(factory)
+    registry.promote(registry.add(factory.init_params(1),
+                                  version="v-admin").version)
+    mv = registry.bootstrap(seed=0)
+    assert router.live_version() == "v-admin"
+    assert registry.get(mv.version).state == "ready"   # resident, demotable
+
+
+def test_rollback_is_promote_of_previous_version(factory):
+    registry, router = _registry(factory)
+    registry.promote(registry.add(factory.init_params(0),
+                                  version="v1").version)
+    registry.promote(registry.add(factory.init_params(1),
+                                  version="v2").version)
+    assert registry.get("v1").state == "ready"    # demoted, resident
+    registry.promote("v1")                        # rollback
+    assert router.live_version() == "v1"
+    assert registry.get("v2").state == "ready"
+
+
+def test_eviction_keeps_live_and_caps_residency(factory):
+    registry, _ = _registry(factory, max_versions=2)
+    registry.promote(registry.add(factory.init_params(0),
+                                  version="v1").version)
+    registry.add(factory.init_params(1), version="v2")
+    registry.add(factory.init_params(2), version="v3")
+    names = [v["version"] for v in registry.describe()["versions"]]
+    assert len(names) == 2
+    assert "v1" in names          # live is never evicted
+    assert "v3" in names          # the just-added version is protected
+    assert "v2" not in names      # oldest routeless version dropped
+    with pytest.raises(ValueError, match="max_versions"):
+        ModelRegistry(factory, factory.make_router(), max_versions=1)
+
+
+def test_add_refuses_when_all_residents_hold_routes(factory):
+    """When live + candidates fill the cap, a further add must fail
+    FAST (before any warmup is spent) instead of either evicting the
+    newcomer it just warmed or blowing past the HBM cap."""
+    registry, _ = _registry(factory, max_versions=2)
+    registry.promote(registry.add(factory.init_params(0),
+                                  version="v1").version)
+    registry.add(factory.init_params(1), version="v2")
+    registry.set_shadow("v2", fraction=0.5)     # both residents in route
+    with pytest.raises(RuntimeError, match="registry full"):
+        registry.add(factory.init_params(2), version="v3")
+    names = [v["version"] for v in registry.describe()["versions"]]
+    assert sorted(names) == ["v1", "v2"]        # nothing vanished
+
+
+def test_describe_answers_during_warmup(factory):
+    """/healthz and GET /models must not block behind a multi-second
+    candidate warmup: describe() takes only the state lock, and the
+    warming version is honestly visible in state 'warming'."""
+    import threading
+
+    registry, _ = _registry(factory)
+    seen_during_warm = []
+    orig_make = factory.make_engine
+
+    def slow_make(params, version):
+        # runs inside add() OUTSIDE the state lock: describe() from
+        # another thread must return immediately
+        t = threading.Thread(target=lambda: seen_during_warm.append(
+            registry.describe()))
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), "describe() blocked during warmup"
+        return orig_make(params, version)
+
+    factory.make_engine = slow_make
+    try:
+        registry.add(factory.init_params(0), version="v1")
+    finally:
+        factory.make_engine = orig_make
+    assert seen_during_warm
+    states = {v["version"]: v["state"]
+              for v in seen_during_warm[0]["versions"]}
+    assert states == {"v1": "warming"}
+
+
+def test_describe_lists_versions_and_routes(factory):
+    registry, _ = _registry(factory)
+    registry.promote(registry.add(factory.init_params(0),
+                                  version="v1").version)
+    registry.add(factory.init_params(1), version="v2")
+    registry.set_shadow("v2", fraction=0.5)
+    d = registry.describe()
+    assert {v["version"] for v in d["versions"]} == {"v1", "v2"}
+    assert d["routes"]["live"] == "v1"
+    assert d["routes"]["shadow"] == {"version": "v2", "fraction": 0.5}
+    assert d["buckets"] == list(factory.buckets)
+
+
+def test_candidate_roles_require_ready_state(factory):
+    registry, _ = _registry(factory)
+    registry.promote(registry.add(factory.init_params(0),
+                                  version="v1").version)
+    with pytest.raises(RuntimeError, match="non-live"):
+        registry.set_shadow("v1", fraction=0.5)   # live can't shadow
+    with pytest.raises(RuntimeError, match="non-live"):
+        registry.set_canary("v1", fraction=0.5)
+
+
+def test_zero_recompiles_through_hot_swap_under_load(factory, rng):
+    """The ISSUE 3 acceptance contract with REAL engines: a mixed-size
+    request stream pushed through the batcher keeps flowing across an
+    atomic hot-swap with exactly zero compile events after the
+    candidate's off-path warmup — and every request resolves."""
+    metrics = ServeMetrics()
+    registry, router = _registry(factory, metrics=metrics)
+    registry.promote(registry.add(factory.init_params(0),
+                                  version="v1").version)
+    b = DynamicBatcher(router, max_wait_us=200, queue_depth=4096,
+                       max_inflight=4, metrics=metrics).start()
+    try:
+        sizes = [1, 3, 7, 8, 9, 15, 16, 5, 12] * 2
+        futs = [(n, b.submit(rng.integers(0, 256, (n, 28, 28, 1))
+                             .astype(np.uint8))) for n in sizes]
+        # load + pre-warm v2 while v1 traffic is in flight (warmup off
+        # the hot path), then swap; sample the counter POST-warmup
+        registry.add(factory.init_params(1), version="v2")
+        before = CompileCounter.instance().snapshot()
+        registry.promote("v2")
+        futs += [(n, b.submit(rng.integers(0, 256, (n, 28, 28, 1))
+                              .astype(np.uint8))) for n in sizes]
+        for n, f in futs:
+            assert f.result(timeout=60).shape == (n, 10)
+    finally:
+        b.stop()
+    assert CompileCounter.instance().snapshot() - before == 0, (
+        "hot-swap to a pre-warmed version recompiled")
+    assert router.live_version() == "v2"
+    # both populations are version-tagged in the metrics
+    assert set(metrics.snapshot()["by_version"]) <= {"v1", "v2"}
+    assert "v2" in metrics.snapshot()["by_version"]

@@ -1,0 +1,342 @@
+"""serve/router.py: version-pure hot-swap under concurrent load (no
+request fails or mixes versions mid-swap), shadow isolation (candidate
+results never reach clients; comparisons and failures are recorded),
+canary population splitting with version-tagged metrics — against stub
+engines whose 'logits' encode which version computed them, so any leak
+or mix is visible in the output bytes."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import (DynamicBatcher, NoLiveModel,
+                                        Router, ServeMetrics)
+from distributedmnist_tpu.serve.engine import InferenceEngine
+
+BUCKETS = (4, 8, 16)
+
+
+class VersionStubEngine:
+    """Engine-shaped double stamping every output row with a per-version
+    constant: row r of a request gets logits full of `stamp`, so a
+    client can prove exactly which version served it. Optional fail
+    flags make dispatch/fetch raise (the broken-candidate case)."""
+
+    platform = "cpu"
+    max_batch = 16
+    buckets = BUCKETS
+
+    def __init__(self, stamp: float, fail_dispatch=False,
+                 fail_fetch=False):
+        self.stamp = stamp
+        self.fail_dispatch = fail_dispatch
+        self.fail_fetch = fail_fetch
+        self.dispatches = 0
+        self._lock = threading.Lock()
+
+    _as_images = staticmethod(InferenceEngine._as_images)
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(n)
+
+    def dispatch(self, x):
+        if self.fail_dispatch:
+            raise RuntimeError("candidate dispatch broke")
+        parts = ([self._as_images(p) for p in x]
+                 if isinstance(x, (list, tuple))
+                 else [self._as_images(x)])
+        n = sum(p.shape[0] for p in parts)
+        with self._lock:
+            self.dispatches += 1
+        return SimpleNamespace(n=n, bucket=self.bucket_for(n))
+
+    def fetch(self, handle):
+        if self.fail_fetch:
+            raise RuntimeError("candidate fetch broke")
+        return np.full((handle.n, 10), self.stamp, np.float32)
+
+
+def _router(metrics=None, seed=0):
+    return Router(max_batch=16, buckets=BUCKETS, platform="cpu",
+                  n_chips=4, metrics=metrics, seed=seed)
+
+
+def _rows(rng, n):
+    return rng.integers(0, 256, (n, 28, 28, 1)).astype(np.uint8)
+
+
+def test_no_live_model_raises_503_semantics(rng):
+    r = _router()
+    with pytest.raises(NoLiveModel) as ei:
+        r.dispatch(_rows(rng, 2))
+    assert ei.value.status == 503
+
+
+def test_no_live_fails_futures_not_the_pipeline(rng):
+    """Submits before any version is live fail their own futures with
+    NoLiveModel; a later set_live serves normally on the same batcher —
+    the pipeline survives the warming window."""
+    r = _router()
+    b = DynamicBatcher(r, max_wait_us=200, queue_depth=256).start()
+    try:
+        f = b.submit(_rows(rng, 2))
+        with pytest.raises(NoLiveModel):
+            f.result(timeout=10)
+        r.set_live(VersionStubEngine(1.0), "v1")
+        out = b.submit(_rows(rng, 2)).result(timeout=10)
+        assert np.all(out == 1.0)
+    finally:
+        b.stop()
+
+
+def test_geometry_mismatch_rejected():
+    r = _router()
+    bad = VersionStubEngine(1.0)
+    bad.buckets = (2, 4)
+    with pytest.raises(ValueError, match="geometry"):
+        r.set_live(bad, "bad")
+    with pytest.raises(ValueError, match="geometry"):
+        r.set_shadow(bad, "bad", 0.5)
+
+
+def test_hot_swap_under_concurrent_load_is_version_pure(rng):
+    """The mid-swap correctness contract: with client threads hammering
+    the batcher while the live version swaps v1 -> v2, every request
+    resolves (no failures), every result is ENTIRELY one version's
+    output (a batch runs one engine's program), and requests completed
+    after the swap settles are v2's."""
+    r = _router()
+    v1, v2 = VersionStubEngine(1.0), VersionStubEngine(2.0)
+    r.set_live(v1, "v1")
+    b = DynamicBatcher(r, max_wait_us=200, queue_depth=4096,
+                       max_inflight=3).start()
+    results, errors = [], []
+    stop = threading.Event()
+
+    def client():
+        lrng = np.random.default_rng(threading.get_ident() % 2**32)
+        while not stop.is_set():
+            n = int(lrng.integers(1, 6))
+            try:
+                out = b.submit(
+                    lrng.integers(0, 256, (n, 28, 28, 1))
+                    .astype(np.uint8)).result(timeout=30)
+                results.append(out)
+            except BaseException as e:
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        r.set_live(v2, "v2")              # the atomic hot-swap
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        stop.set()
+        b.stop()
+    assert not errors, f"requests failed across the swap: {errors[:3]}"
+    assert results, "no traffic flowed"
+    for out in results:
+        first = out[0, 0]
+        assert first in (1.0, 2.0)
+        assert np.all(out == first), (
+            "a single request mixed model versions")
+    # traffic genuinely crossed the swap: both versions served some
+    stamps = {out[0, 0] for out in results}
+    assert stamps == {1.0, 2.0}, f"swap never observed: {stamps}"
+    # a fresh request after the swap is v2's
+    b2 = DynamicBatcher(r, max_wait_us=200, queue_depth=64).start()
+    try:
+        assert np.all(b2.submit(_rows(rng, 2)).result(timeout=10) == 2.0)
+    finally:
+        b2.stop()
+
+
+def test_shadow_results_never_reach_clients(rng):
+    """Shadow mode duplicates traffic and COMPARES, but the client
+    always gets the live result; the comparison lands in metrics."""
+    metrics = ServeMetrics()
+    r = _router(metrics=metrics)
+    live, shadow = VersionStubEngine(1.0), VersionStubEngine(9.0)
+    r.set_live(live, "v1")
+    r.set_shadow(shadow, "v9", fraction=1.0)
+    b = DynamicBatcher(r, max_wait_us=200, queue_depth=256,
+                       metrics=metrics).start()
+    try:
+        for _ in range(6):
+            out = b.submit(_rows(rng, 3)).result(timeout=10)
+            assert np.all(out == 1.0), "shadow output leaked to a client"
+    finally:
+        b.stop()
+    assert shadow.dispatches >= 6        # the duplicate traffic arrived
+    r.drain_shadow(10)                   # comparisons land async
+    snap = metrics.snapshot()
+    pair = snap["shadow"]["v1->v9"]
+    assert pair["rows"] >= 18
+    assert pair["agreement"] is not None
+    assert pair["max_abs_diff"] == pytest.approx(8.0)
+    # shadow population is NOT in by_version: it served no client
+    assert "v9" not in snap["by_version"]
+
+
+def test_shadow_sampling_respects_fraction(rng):
+    metrics = ServeMetrics()
+    r = _router(metrics=metrics, seed=0)
+    live, shadow = VersionStubEngine(1.0), VersionStubEngine(2.0)
+    r.set_live(live, "v1")
+    r.set_shadow(shadow, "v2", fraction=0.25)
+    for _ in range(200):
+        r.fetch(r.dispatch(_rows(rng, 1)))
+    # seeded draws: the sampled share must sit near the fraction
+    assert 20 <= shadow.dispatches <= 80, shadow.dispatches
+
+
+def test_broken_shadow_never_breaks_live_traffic(rng):
+    """A candidate that throws on dispatch AND one that throws on fetch:
+    clients see only live results; the failures are counted."""
+    for mode in ("fail_dispatch", "fail_fetch"):
+        metrics = ServeMetrics()
+        r = _router(metrics=metrics)
+        r.set_live(VersionStubEngine(1.0), "v1")
+        r.set_shadow(VersionStubEngine(5.0, **{mode: True}), "bad",
+                     fraction=1.0)
+        b = DynamicBatcher(r, max_wait_us=200, queue_depth=256,
+                           metrics=metrics).start()
+        try:
+            for _ in range(3):
+                out = b.submit(_rows(rng, 2)).result(timeout=10)
+                assert np.all(out == 1.0), mode
+        finally:
+            b.stop()
+        r.drain_shadow(10)
+        assert metrics.snapshot()["shadow_errors"] >= 3, mode
+
+
+def test_slow_shadow_does_not_stall_live_fanout(rng):
+    """A shadow candidate wedged in fetch must not delay live results:
+    comparisons drain on their own thread, so live futures resolve at
+    live speed even while the shadow's fetch blocks."""
+    metrics = ServeMetrics()
+    r = _router(metrics=metrics)
+    gate = threading.Event()
+
+    class SlowShadow(VersionStubEngine):
+        def fetch(self, handle):
+            assert gate.wait(timeout=30)
+            return super().fetch(handle)
+
+    r.set_live(VersionStubEngine(1.0), "v1")
+    r.set_shadow(SlowShadow(2.0), "v2", fraction=1.0)
+    b = DynamicBatcher(r, max_wait_us=200, queue_depth=256).start()
+    try:
+        t0 = time.monotonic()
+        for _ in range(4):
+            out = b.submit(_rows(rng, 2)).result(timeout=5)
+            assert np.all(out == 1.0)
+        assert time.monotonic() - t0 < 4.0, (
+            "live results waited on the wedged shadow fetch")
+        assert r.shadow_pending() >= 1   # comparisons queued, not done
+        gate.set()
+        r.drain_shadow(10)
+        assert metrics.snapshot()["shadow"]["v1->v2"]["batches"] >= 1
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_canary_splits_traffic_with_version_tagged_metrics(rng):
+    """Canary mode routes a fraction FOR REAL: both versions' outputs
+    reach clients, and ServeMetrics separates the populations by
+    version tag (requests/rows/latency per version)."""
+    metrics = ServeMetrics()
+    r = _router(metrics=metrics, seed=1)
+    v1, v2 = VersionStubEngine(1.0), VersionStubEngine(2.0)
+    r.set_live(v1, "v1")
+    r.set_canary(v2, "v2", fraction=0.3)
+    b = DynamicBatcher(r, max_wait_us=0, queue_depth=4096,
+                       metrics=metrics).start()
+    served = []
+    try:
+        for _ in range(120):
+            f = b.submit(_rows(rng, 1))
+            served.append((f.result(timeout=10)[0, 0],
+                           getattr(f, "version", None)))
+    finally:
+        b.stop()
+    assert {s for s, _ in served} == {1.0, 2.0}, "canary never served"
+    # the future's version tag attributes each request to the version
+    # that actually computed it (stub stamp 1.0 <-> v1, 2.0 <-> v2)
+    for stamp, version in served:
+        assert version == {1.0: "v1", 2.0: "v2"}[stamp]
+    snap = metrics.snapshot()
+    bv = snap["by_version"]
+    assert set(bv) == {"v1", "v2"}
+    total = bv["v1"]["requests"] + bv["v2"]["requests"]
+    assert total == 120
+    assert 0 < bv["v2"]["requests"] < bv["v1"]["requests"]
+    for v in ("v1", "v2"):
+        assert bv[v]["latency_ms"]["p50"] is not None
+
+
+def test_shadow_duplication_bounded_by_cap(rng):
+    """A wedged candidate must cost bounded memory: past shadow_cap
+    outstanding duplicates, sampled batches skip the duplicate (counted
+    as shadow_dropped) instead of growing the queue without bound."""
+    metrics = ServeMetrics()
+    r = Router(max_batch=16, buckets=BUCKETS, platform="cpu",
+               n_chips=4, metrics=metrics, shadow_cap=2)
+    gate = threading.Event()
+
+    class WedgedShadow(VersionStubEngine):
+        def fetch(self, handle):
+            assert gate.wait(timeout=30)
+            return super().fetch(handle)
+
+    shadow = WedgedShadow(2.0)
+    r.set_live(VersionStubEngine(1.0), "v1")
+    r.set_shadow(shadow, "v2", fraction=1.0)
+    try:
+        for _ in range(10):
+            r.fetch(r.dispatch(_rows(rng, 1)))   # live results flow
+        assert r.shadow_pending() <= 2
+        assert shadow.dispatches <= 2, (
+            "duplication ran past the outstanding cap")
+        assert metrics.snapshot()["shadow_dropped"] == 8
+    finally:
+        gate.set()
+    r.drain_shadow(10)
+    assert r.shadow_pending() == 0
+
+
+def test_promote_clears_candidate_role(rng):
+    """Promoting the canary/shadow version to live clears its candidate
+    role — it can't shadow itself."""
+    r = _router()
+    v1, v2 = VersionStubEngine(1.0), VersionStubEngine(2.0)
+    r.set_live(v1, "v1")
+    r.set_canary(v2, "v2", fraction=0.5)
+    r.set_live(v2, "v2")
+    routes = r.routes()
+    assert routes == {"live": "v2", "canary": None, "shadow": None}
+
+
+def test_fraction_validation():
+    r = _router()
+    eng = VersionStubEngine(1.0)
+    for bad in (0.0, -0.1, 1.5):
+        with pytest.raises(ValueError, match="fraction"):
+            r.set_shadow(eng, "v", bad)
+    with pytest.raises(ValueError, match="fraction"):
+        r.set_canary(eng, "v", 1.0)   # canary must leave live traffic
