@@ -160,6 +160,20 @@ def main(argv=None) -> int:
                    help="[serve] pipelined dispatch window for the "
                         "headline phase (default 4); the capacity phase "
                         "always also runs at 1 for the serial baseline")
+    p.add_argument("--serve-slo-ms", type=float, default=None,
+                   help="[serve] per-request latency SLO driving the "
+                        "adaptive coalescing controller (default: none "
+                        "— the controller is inert beyond its "
+                        "arrival-rate fill cap)")
+    p.add_argument("--no-adaptive", action="store_true", default=None,
+                   help="[serve] pin the static coalescing wait instead "
+                        "of the SLO-aware adaptive controller")
+    p.add_argument("--baseline", default=None, metavar="BENCH_serve.json",
+                   help="[serve] a prior BENCH_serve_r*.json to diff "
+                        "against: prints a delta table and REFUSES "
+                        "(nonzero exit) when detail.host.device_kind "
+                        "differs — CPU records must never masquerade as "
+                        "TPU headlines (ROADMAP)")
     p.add_argument("--swap-during-load", action="store_true", default=None,
                    help="[serve] add a closed-loop phase with a REAL "
                         "model roll mid-window: load + pre-warm a second "
@@ -191,6 +205,9 @@ def main(argv=None) -> int:
                    "--serve-max-wait-us": args.serve_max_wait_us,
                    "--serve-queue-depth": args.serve_queue_depth,
                    "--serve-max-inflight": args.serve_max_inflight,
+                   "--serve-slo-ms": args.serve_slo_ms,
+                   "--no-adaptive": args.no_adaptive,
+                   "--baseline": args.baseline,
                    "--swap-during-load": args.swap_during_load,
                    "--artifact-dir": args.artifact_dir,
                    "--no-artifact": args.no_artifact}
@@ -235,6 +252,27 @@ def main(argv=None) -> int:
                 p.error("--serve-qps must be comma-separated numbers")
             if not args.serve_qps or args.serve_qps[0] <= 0:
                 p.error("--serve-qps targets must be positive")
+        if args.serve_slo_ms is not None and args.serve_slo_ms <= 0:
+            p.error("--serve-slo-ms must be > 0")
+        if args.baseline is not None:
+            # An unreadable/shapeless baseline is a usage error NOW; the
+            # device_kind REFUSAL must wait for the backend (the worker
+            # compares against the live mesh before any load phase).
+            try:
+                with open(args.baseline) as f:
+                    base = json.load(f)
+            except (OSError, ValueError) as e:
+                p.error(f"--baseline {args.baseline!r}: {e}")
+            detail = base.get("detail") if isinstance(base, dict) else None
+            host = (detail.get("host") if isinstance(detail, dict)
+                    else None)
+            kind = (host.get("device_kind") if isinstance(host, dict)
+                    else None)
+            if not kind:
+                p.error(f"--baseline {args.baseline!r} has no "
+                        "detail.host.device_kind — not a "
+                        "BENCH_serve_r*.json artifact (pre-provenance "
+                        "records can't be safely compared)")
         # LAST among the validations (its mkdir is a side effect; every
         # pure usage error above must fire first): fail a bad artifact
         # dir NOW — discovering it after the multi-minute load phases
@@ -735,13 +773,16 @@ def _smoke(args) -> int:
     return 0
 
 
-def _serve_closed_loop(batcher, metrics, req, clients: int,
+def _serve_closed_loop(batcher, metrics, reqs, clients: int,
                        duration: float) -> dict:
     """Closed loop: each client waits for its result before the next
     submit, so concurrency == clients and the batcher coalesces to its
     natural occupancy — serving capacity, not queue-melt throughput.
-    A short unmeasured ramp absorbs phase cold-start (client thread
-    spawn, allocator warmup) so back-to-back phases compare fairly."""
+    `reqs` is a list of pre-built request arrays each client cycles
+    through (one entry = the classic fixed-size load; a seeded
+    mixed-size list = the ragged-arrival leg). A short unmeasured ramp
+    absorbs phase cold-start (client thread spawn, allocator warmup) so
+    back-to-back phases compare fairly."""
     import threading
 
     from distributedmnist_tpu.serve import Rejected
@@ -750,10 +791,12 @@ def _serve_closed_loop(batcher, metrics, req, clients: int,
     ramp = min(0.5, duration * 0.2)
     stop_at = time.monotonic() + ramp + duration
 
-    def client():
-        while time.monotonic() < stop_at:
+    def client(offset: int):
+        k = offset                  # stagger starts so the size mix
+        while time.monotonic() < stop_at:   # interleaves across clients
             try:
-                batcher.submit(req).result(timeout=120)
+                batcher.submit(reqs[k % len(reqs)]).result(timeout=120)
+                k += 1
             except Rejected:
                 time.sleep(0.001)   # shed: brief client backoff
             except BaseException as e:
@@ -762,8 +805,8 @@ def _serve_closed_loop(batcher, metrics, req, clients: int,
                 client_errors.append(e)
                 return
 
-    threads = [threading.Thread(target=client, daemon=True)
-               for _ in range(clients)]
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
     for t in threads:
         t.start()
     time.sleep(ramp)
@@ -799,13 +842,14 @@ def _drain_or_die(batcher, timeout: float) -> None:
         time.sleep(0.005)
 
 
-def _serve_open_loop(batcher, metrics, req, qps: float, duration: float,
+def _serve_open_loop(batcher, metrics, reqs, qps: float, duration: float,
                      max_wait_us: int) -> tuple[int, dict]:
-    """Open loop: Poisson arrivals at the target QPS. Submissions don't
-    wait for results (metrics record latency at completion), so queue
-    growth and backpressure rejections are visible exactly when the
-    target exceeds capacity. Returns (submitted, metrics snapshot) after
-    the queue and in-flight window have drained."""
+    """Open loop: Poisson arrivals at the target QPS, cycling through
+    the `reqs` request list (fixed-size or the ragged mix). Submissions
+    don't wait for results (metrics record latency at completion), so
+    queue growth and backpressure rejections are visible exactly when
+    the target exceeds capacity. Returns (submitted, metrics snapshot)
+    after the queue and in-flight window have drained."""
     import random
 
     from distributedmnist_tpu.serve import Rejected
@@ -820,13 +864,150 @@ def _serve_open_loop(batcher, metrics, req, qps: float, duration: float,
         if next_t > now:
             time.sleep(next_t - now)
         try:
-            batcher.submit(req)
+            batcher.submit(reqs[submitted % len(reqs)])
             submitted += 1
         except Rejected:
             pass                # recorded by metrics
         next_t += arrivals.expovariate(qps)
     _drain_or_die(batcher, timeout=120 + max_wait_us / 1e6)
     return submitted, metrics.snapshot()
+
+
+def _serve_ragged_leg(router, metrics, factory, make_batcher,
+                      pipelined: int, clients: int, duration: float,
+                      qps: float, max_wait_us: int,
+                      max_size: int = 20) -> dict:
+    """The batch-former proof leg (ISSUE 4 acceptance): one FIXED
+    mixed-size request stream — sizes uniform on {1..min(20, max_batch)},
+    seeded, identical across sub-phases — replayed closed-loop (capacity
+    + waste at natural occupancy) and open-loop (waste under Poisson
+    arrivals at a sub-capacity rate), each with the cost-model batch
+    former OFF (pad the whole drain to one covering bucket) and ON
+    (split when the measured cost table says split beats pad). The
+    scheduler's win is then a measured padding_waste_ratio reduction at
+    no-worse goodput, not a claim. Adaptation is pinned off in BOTH
+    sub-phases so the comparison isolates the former.
+
+    Both sub-phases coalesce with the SAME wait, derived from the
+    measured cost table rather than the serving default: one full-batch
+    service time (fitted overhead + per_row * top_bucket — the classic
+    batching balance point, and itself an application of 'exploit the
+    predictable per-program costs'). A 1 ms wait on a host whose batch
+    service time is tens of ms never assembles a multi-request drain,
+    and a drain of ONE request can neither pad interestingly nor be
+    split at all — the former would be measured on traffic that never
+    exercises it."""
+    import numpy as np
+
+    from distributedmnist_tpu.serve.scheduler import fit_dispatch_cost
+
+    max_size = min(max_size, factory.max_batch)
+    rng = np.random.default_rng(7)
+    sizes = [int(s) for s in rng.integers(1, max_size + 1, 256)]
+    reqs = [rng.integers(0, 256, (n, 28, 28, 1), dtype=np.uint8)
+            for n in sizes]
+    overhead_s, per_row_s = fit_dispatch_cost(router.bucket_costs())
+    ragged_wait_us = max(max_wait_us, int(
+        (overhead_s + per_row_s * factory.buckets[-1]) * 1e6))
+
+    def phase(split: bool) -> dict:
+        tag = "former-on" if split else "former-off"
+        b = make_batcher(pipelined, split=split, adaptive=False,
+                         wait_us=ragged_wait_us)
+        try:
+            _mark(f"ragged closed loop [{tag}]: {clients} clients "
+                  f"x {duration:.0f}s, sizes U[1,{max_size}], "
+                  f"wait {ragged_wait_us}us")
+            closed = _serve_closed_loop(b, metrics, reqs, clients,
+                                        duration)
+            _mark(f"ragged open loop [{tag}] qps={qps:g}")
+            _, openl = _serve_open_loop(b, metrics, reqs, qps, duration,
+                                        ragged_wait_us)
+        finally:
+            b.stop()
+        keep = ("rows_per_sec", "requests_per_sec", "latency_ms",
+                "padding_waste_ratio", "padded_rows", "dispatched_rows",
+                "bucket_dispatches", "mean_rows_per_batch", "batches",
+                "rejected_requests")
+        return {"closed": {k: closed[k] for k in keep},
+                "open": {k: openl[k] for k in keep}}
+
+    off = phase(split=False)
+    on = phase(split=True)
+
+    def ratio(a, b):
+        return round(a / b, 3) if a is not None and b else None
+
+    leg = {
+        "sizes": f"uniform[1..{max_size}]",
+        "seed": 7,
+        "open_loop_qps": qps,
+        "coalesce_wait_us": ragged_wait_us,
+        "former_off": off,
+        "former_on": on,
+        # the headline pair: FLOPs burned on padding, and goodput —
+        # split must cut the former without costing the latter
+        "closed_waste_off": off["closed"]["padding_waste_ratio"],
+        "closed_waste_on": on["closed"]["padding_waste_ratio"],
+        "closed_waste_reduction_x": ratio(
+            off["closed"]["padding_waste_ratio"],
+            on["closed"]["padding_waste_ratio"]),
+        "closed_goodput_ratio": ratio(on["closed"]["rows_per_sec"],
+                                      off["closed"]["rows_per_sec"]),
+        "open_waste_off": off["open"]["padding_waste_ratio"],
+        "open_waste_on": on["open"]["padding_waste_ratio"],
+        "open_waste_reduction_x": ratio(
+            off["open"]["padding_waste_ratio"],
+            on["open"]["padding_waste_ratio"]),
+    }
+    _mark(f"ragged: closed waste {leg['closed_waste_off']} -> "
+          f"{leg['closed_waste_on']} "
+          f"({leg['closed_waste_reduction_x']}x reduction), goodput "
+          f"ratio {leg['closed_goodput_ratio']}; open waste "
+          f"{leg['open_waste_off']} -> {leg['open_waste_on']}")
+    return leg
+
+
+def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
+    """The --baseline comparison block: current-vs-prior deltas on the
+    stable serve signals (device_kind equality was enforced before any
+    load phase ran). Printed to stderr as a small table AND embedded in
+    the record so the artifact itself carries the round-over-round
+    story."""
+    cur_d, base_d = record["detail"], baseline.get("detail", {})
+
+    def pct(cur, prev):
+        return (round(100.0 * (cur - prev) / prev, 1)
+                if cur is not None and prev else None)
+
+    rows = {
+        "img_s_chip": (record["value"], baseline.get("value")),
+        "closed_p99_ms": (
+            cur_d["closed_loop"]["latency_ms"]["p99"],
+            base_d.get("closed_loop", {}).get("latency_ms", {})
+            .get("p99")),
+        "ragged_closed_waste": (
+            (cur_d.get("ragged") or {}).get("closed_waste_on"),
+            (base_d.get("ragged") or {}).get("closed_waste_on")),
+        "recompiles_after_warmup": (
+            cur_d["recompiles_after_warmup"],
+            base_d.get("recompiles_after_warmup")),
+    }
+    delta = {"path": path,
+             "baseline_value": baseline.get("value"),
+             "baseline_device_kind": base_d.get("host", {})
+             .get("device_kind")}
+    _mark(f"baseline delta vs {os.path.basename(path)} "
+          f"(device_kind {delta['baseline_device_kind']}):")
+    for name, (cur, prev) in rows.items():
+        d = pct(cur, prev)
+        delta[name] = {"current": cur, "baseline": prev,
+                       "delta_pct": d}
+        _mark(f"  {name:<24} {prev} -> {cur}"
+              f" ({'+' if d is not None and d >= 0 else ''}{d}%)"
+              if d is not None else
+              f"  {name:<24} {prev} -> {cur}")
+    return delta
 
 
 def _next_serve_artifact(artifact_dir: str) -> str:
@@ -988,6 +1169,24 @@ def _serve(args) -> int:
     pipelined = (4 if args.serve_max_inflight is None
                  else args.serve_max_inflight)
 
+    baseline_rec = None
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline_rec = json.load(f)       # shape pre-validated
+        base_kind = baseline_rec["detail"]["host"]["device_kind"]
+        this_kind = _host_provenance(factory)["device_kind"]
+        if base_kind != this_kind:
+            # The ROADMAP warning, mechanized: refuse BEFORE any load
+            # phase — a delta table across different silicon is exactly
+            # the CPU-record-as-TPU-headline confusion this flag exists
+            # to prevent.
+            _mark(f"REFUSING --baseline {args.baseline}: it was "
+                  f"measured on device_kind={base_kind!r}, this host "
+                  f"is {this_kind!r} — cross-silicon serve deltas are "
+                  "meaningless (ROADMAP: CPU records must not "
+                  "masquerade as TPU headlines)")
+            return 4
+
     _mark(f"warming {len(factory.buckets)} buckets "
           f"{list(factory.buckets)}")
     boot = registry.bootstrap(seed=cfg.seed)   # load + pre-warm + promote
@@ -998,11 +1197,18 @@ def _serve(args) -> int:
     rng = np.random.default_rng(0)
     req = rng.integers(0, 256, (rows, 28, 28, 1), dtype=np.uint8)
 
-    def make_batcher(max_inflight: int) -> DynamicBatcher:
+    def make_batcher(max_inflight: int, split: bool = True,
+                     adaptive: bool = None,
+                     wait_us: int = None) -> DynamicBatcher:
+        if adaptive is None:
+            adaptive = not args.no_adaptive
         return DynamicBatcher(router, max_batch=factory.max_batch,
-                              max_wait_us=max_wait_us,
+                              max_wait_us=(max_wait_us if wait_us is None
+                                           else wait_us),
                               queue_depth=queue_depth,
                               max_inflight=max_inflight,
+                              slo_ms=args.serve_slo_ms,
+                              adaptive=adaptive, split=split,
                               metrics=metrics).start()
 
     # Phase 1 — serial baseline: inflight=1 is the pre-pipeline chain
@@ -1012,13 +1218,13 @@ def _serve(args) -> int:
     low_qps = min(qps_sweep)
     serial = make_batcher(1)
     _mark(f"closed loop [inflight=1]: {clients} clients x {duration:.0f}s")
-    closed_serial = _serve_closed_loop(serial, metrics, req, clients,
+    closed_serial = _serve_closed_loop(serial, metrics, [req], clients,
                                        duration)
     serial_value = closed_serial["rows_per_sec"] / factory.n_chips
     _mark(f"closed loop [inflight=1]: {serial_value:.0f} img/s/chip "
           f"(p99 {closed_serial['latency_ms']['p99']} ms)")
     _mark(f"open loop [inflight=1] qps={low_qps:g}")
-    _, open_serial = _serve_open_loop(serial, metrics, req, low_qps,
+    _, open_serial = _serve_open_loop(serial, metrics, [req], low_qps,
                                       duration, max_wait_us)
     serial.stop()
 
@@ -1027,7 +1233,7 @@ def _serve(args) -> int:
     piped = make_batcher(pipelined)
     _mark(f"closed loop [inflight={piped.max_inflight}]: "
           f"{clients} clients x {duration:.0f}s")
-    closed = _serve_closed_loop(piped, metrics, req, clients, duration)
+    closed = _serve_closed_loop(piped, metrics, [req], clients, duration)
     value = closed["rows_per_sec"] / factory.n_chips
     speedup = value / max(serial_value, 1e-9)
     _mark(f"closed loop [inflight={piped.max_inflight}]: {value:.0f} "
@@ -1036,7 +1242,7 @@ def _serve(args) -> int:
 
     table = []
     for qps in qps_sweep:
-        submitted, snap = _serve_open_loop(piped, metrics, req, qps,
+        submitted, snap = _serve_open_loop(piped, metrics, [req], qps,
                                            duration, max_wait_us)
         table.append({
             "qps_target": qps,
@@ -1055,7 +1261,16 @@ def _serve(args) -> int:
               f"{snap['latency_ms']['p50']} ms, "
               f"{snap['rejected_requests']} rejected")
 
-    # Phase 3 (optional) — the model roll: closed-loop traffic crossing
+    # Phase 3 — the ragged-arrival leg: the batch former's measured
+    # win (padding-waste reduction at no-worse goodput) on a fixed
+    # mixed-size request stream, former off vs on. Runs on its own
+    # batchers; the pipelined batcher stays up for the optional swap
+    # phase below.
+    ragged = _serve_ragged_leg(router, metrics, factory, make_batcher,
+                               pipelined, clients, duration, low_qps,
+                               max_wait_us)
+
+    # Phase 4 (optional) — the model roll: closed-loop traffic crossing
     # a real load + pre-warm + atomic promote (ISSUE 3 acceptance:
     # recompiles_after_swap == 0 and swap-window p99 within 1.5x the
     # steady-state p99 on the same host). Runs BEFORE the whole-run
@@ -1136,8 +1351,14 @@ def _serve(args) -> int:
             "live_version_final": registry.live_version(),
             "warmup_compile_events": warm_compiles,
             "recompiles_after_warmup": recompiles,
+            "bucket_cost_ms": {str(b): round(c * 1e3, 3)
+                               for b, c in sorted(
+                                   router.bucket_costs().items())},
+            "slo_ms": args.serve_slo_ms,
+            "adaptive": not args.no_adaptive,
             "closed_loop": closed,
             "qps_sweep": table,
+            "ragged": ragged,
             "swap": swap,
             # The measured overlap win (ISSUE 2 acceptance): pipelined
             # capacity over the serial chain, and sub-capacity open-loop
@@ -1155,6 +1376,9 @@ def _serve(args) -> int:
             },
         },
     }
+    if baseline_rec is not None:
+        record["detail"]["baseline"] = _baseline_delta(
+            record, baseline_rec, args.baseline)
     print(json.dumps(record))
     if not args.no_artifact:
         # Best-effort: the record is already on stdout; an unwritable

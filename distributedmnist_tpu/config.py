@@ -117,6 +117,16 @@ class Config:
     # (1 on CPU, where staging and compute share the same cores; a small
     # window on accelerators). 1 = the fully serial chain.
     serve_max_inflight: Optional[int] = None
+    # adaptive batch scheduling (serve/scheduler.py): serve_slo_ms is
+    # the per-request latency objective the AIMD controller defends —
+    # observed violations step the effective coalescing wait down
+    # (multiplicative), sustained headroom creeps it back up (additive),
+    # always hard-capped at serve_max_wait_us. None = no SLO: the
+    # controller is inert beyond its arrival-rate fill cap.
+    # serve_adaptive=False (--no-adaptive) pins the static wait — the
+    # escape hatch when the controller itself is suspected.
+    serve_slo_ms: Optional[float] = None
+    serve_adaptive: bool = True
     # model lifecycle (serve/registry.py): how many warmed versions the
     # registry keeps resident (live + rollback/candidate set). Each
     # resident version pins a full param set in device memory — the cap
@@ -228,6 +238,15 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="[serving] max dispatched-but-unfetched batches "
                         "kept in flight (pipelined dispatch; default: "
                         "1 on cpu, 4 on accelerators)")
+    p.add_argument("--serve-slo-ms", type=float, default=None,
+                   help="[serving] per-request latency SLO in ms: the "
+                        "adaptive controller steps the effective "
+                        "coalescing wait down on violations and back up "
+                        "under headroom (hard cap: --serve-max-wait-us)")
+    p.add_argument("--no-adaptive", dest="serve_adaptive",
+                   action="store_false", default=None,
+                   help="[serving] pin the static coalescing wait "
+                        "instead of the SLO-aware adaptive controller")
     p.add_argument("--serve-max-versions", type=int, default=None,
                    help="[serving] warmed model versions kept resident "
                         "in the registry (live + rollback/candidates); "

@@ -2,9 +2,12 @@
 north star's "serves heavy traffic from millions of users".
 
 - engine.py   bucketed, jitted, donated forward step over the 'data' mesh,
-              split into dispatch()/fetch() around the async device queue
+              split into dispatch()/fetch() around the async device queue;
+              warmup measures a per-bucket cost table
 - batcher.py  dynamic micro-batcher pipelined through a bounded in-flight
               window, with bounded-queue backpressure
+- scheduler.py cost-model batch former (split-vs-pad planning) and the
+              Clipper-style AIMD adaptive-coalescing controller
 - metrics.py  latency percentiles / occupancy / qps / pipeline depth,
               staging-vs-fetch split, per-version populations and
               shadow-comparison aggregates, JSON-line records
@@ -32,6 +35,10 @@ _EXPORTS = {
     "resolve_max_inflight": ("distributedmnist_tpu.serve.batcher",
                              "resolve_max_inflight"),
     "ServeMetrics": ("distributedmnist_tpu.serve.metrics", "ServeMetrics"),
+    "AdaptiveController": ("distributedmnist_tpu.serve.scheduler",
+                           "AdaptiveController"),
+    "plan_segments": ("distributedmnist_tpu.serve.scheduler",
+                      "plan_segments"),
     "EngineFactory": ("distributedmnist_tpu.serve.registry",
                       "EngineFactory"),
     "ModelRegistry": ("distributedmnist_tpu.serve.registry",

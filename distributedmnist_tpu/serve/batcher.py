@@ -28,6 +28,18 @@ bound: under overload a closed feedback to the client keeps the p99 of
 ACCEPTED requests near the service time, where an unbounded queue would
 melt every request's latency together (the Clipper/Clockwork admission
 argument — PAPERS.md).
+
+Scheduling (ISSUE 4, serve/scheduler.py): each drain is run through the
+cost-model **batch former** — when the engine's measured per-bucket cost
+table says several right-sized dispatches beat one padded covering
+bucket (20 rows -> 16+4 instead of 32), the drain is split at request
+boundaries and the segments feed the in-flight window back-to-back
+(`split=False` restores the single-dispatch behaviour). The coalescing
+wait is **adaptive**: an AIMD controller steps the effective wait down
+on SLO violations (`slo_ms`) and creeps it back up under headroom, with
+the configured `max_wait_us` as a hard cap and an arrival-rate EWMA
+bounding the wait at the batch fill time (`adaptive=False` pins the
+static wait — serve.py's --no-adaptive).
 """
 
 from __future__ import annotations
@@ -39,6 +51,9 @@ from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional
+
+from distributedmnist_tpu.serve.scheduler import (AdaptiveController,
+                                                  plan_segments)
 
 
 class Rejected(RuntimeError):
@@ -84,15 +99,28 @@ class DynamicBatcher:
     def __init__(self, engine, max_batch: Optional[int] = None,
                  max_wait_us: int = 1000,
                  queue_depth: int = 4096, metrics=None,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 slo_ms: Optional[float] = None, adaptive: bool = True,
+                 split: bool = True):
         self.engine = engine
         self.max_batch = min(max_batch or engine.max_batch,
                              engine.buckets[-1])
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if slo_ms is not None and slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {slo_ms}")
         self.max_wait_s = max_wait_us / 1e6
         self.queue_depth = queue_depth
         self.metrics = metrics
+        # The batch former (split) and the AIMD wait controller
+        # (adaptive) — serve/scheduler.py. The controller is inert
+        # without an SLO beyond its arrival-rate fill cap, so leaving
+        # adaptive=True with slo_ms=None keeps the static behaviour.
+        self.split = split
+        self.controller = (AdaptiveController(
+            self.max_wait_s,
+            slo_s=slo_ms / 1e3 if slo_ms is not None else None,
+            max_batch=self.max_batch) if adaptive else None)
         self.max_inflight = resolve_max_inflight(
             max_inflight, getattr(engine, "platform", "cpu"))
         self._q: deque[_Request] = deque()
@@ -104,6 +132,12 @@ class DynamicBatcher:
         # dispatched-but-unresolved batches never exceed max_inflight.
         self._slots = threading.Semaphore(self.max_inflight)
         self._inflight = 0
+        # DISPATCHED-but-unresolved segments only (each holds a window
+        # slot, so this never exceeds max_inflight): the depth gauge
+        # metrics export. _inflight additionally counts a split drain's
+        # popped-but-undispatched segments — the drain predicate — and
+        # would read phantom overlap if exported as depth.
+        self._dispatched = 0
         self._inflight_lock = threading.Lock()
         # dispatch -> completion, FIFO; None is the shutdown sentinel.
         self._handles: queue.SimpleQueue = queue.SimpleQueue()
@@ -135,6 +169,8 @@ class DynamicBatcher:
             self._q.append(req)
             self._rows += n
             self._cond.notify_all()
+        if self.controller is not None:
+            self.controller.on_arrival(n, now=req.t_enqueue)
         return req.future
 
     def pending_rows(self) -> int:
@@ -142,10 +178,13 @@ class DynamicBatcher:
             return self._rows
 
     def inflight_batches(self) -> int:
-        """Batches popped off the queue whose futures have not yet all
-        resolved (<= max_inflight by construction — the pipeline-depth
-        invariant tests assert). pending_rows()==0 AND
-        inflight_batches()==0 together mean fully drained."""
+        """Dispatch segments popped off the queue whose futures have not
+        yet all resolved. DISPATCHED-but-unfetched segments never exceed
+        max_inflight (each holds a window slot — the pipeline-depth
+        invariant tests assert it engine-side); a split drain's
+        not-yet-dispatched segments are counted here too, so
+        pending_rows()==0 AND inflight_batches()==0 together still mean
+        fully drained."""
         with self._inflight_lock:
             return self._inflight
 
@@ -173,11 +212,16 @@ class DynamicBatcher:
     def stop(self, drain: bool = True) -> None:
         """Stop the pipeline; drain=True serves what is already queued
         AND fetches every in-flight batch before returning (every
-        accepted future resolves), drain=False fails still-queued
-        futures immediately — in-flight batches are already on the
-        device, so their futures still resolve when their fetch lands
-        (the threads are daemons; a wedged fetch is abandoned after a
-        short join rather than holding stop() hostage)."""
+        accepted future resolves) — including segments a split-dispatch
+        cycle has popped off the queue but not yet dispatched: they were
+        claimed in-flight at pop time and the dispatch loop finishes the
+        whole planned drain before it re-checks for shutdown, so no
+        popped request can be stranded (the PR 2 drain hole, audited for
+        the batch-former window). drain=False fails still-queued futures
+        immediately — in-flight batches are already on the device, so
+        their futures still resolve when their fetch lands (the threads
+        are daemons; a wedged fetch is abandoned after a short join
+        rather than holding stop() hostage)."""
         with self._cond:
             self._stop = True
             if not drain:
@@ -193,17 +237,35 @@ class DynamicBatcher:
                 t.join(timeout=timeout)
         self._dispatcher = self._completer = None
 
-    def _take_batch(self) -> list[_Request]:
+    def _take_batch(self) -> list[list[_Request]]:
         """Block until there is work, then coalesce: wait until max_batch
-        rows are pending or max_wait has elapsed since the OLDEST pending
-        request, then pop a prefix of the queue that fits max_batch.
-        Returns [] only when stopping with an empty queue."""
+        rows are pending or the EFFECTIVE wait (adaptive controller,
+        hard-capped at max_wait_us) has elapsed since the OLDEST pending
+        request, then pop a prefix of the queue that fits max_batch and
+        run it through the batch former. Returns the planned dispatch
+        segments — usually one; several when the cost table says split
+        beats pad — and [] only when stopping with an empty queue.
+
+        Every popped request is claimed in-flight HERE, before the queue
+        lock drops: an observer that sees pending_rows()==0 is then
+        guaranteed to see ALL of this drain's segments (including the
+        not-yet-dispatched ones) in inflight_batches(), so "pending==0
+        and inflight==0" really means drained — the bench's open-loop
+        drain predicate, and the reason stop(drain=True) cannot lose a
+        popped-but-undispatched segment (the PR 2 drain hole, audited
+        for the split window)."""
         with self._cond:
             while not self._q and not self._stop:
                 self._cond.wait(0.1)
             if not self._q:
                 return []
-            deadline = self._q[0].t_enqueue + self.max_wait_s
+            # Sample the effective wait when work is actually in hand
+            # (the controller may have moved while the queue was idle).
+            wait_s = (self.controller.effective_wait_s()
+                      if self.controller is not None else self.max_wait_s)
+            if self.metrics is not None:
+                self.metrics.record_wait(wait_s)
+            deadline = self._q[0].t_enqueue + wait_s
             while self._rows < self.max_batch and not self._stop:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -216,15 +278,32 @@ class DynamicBatcher:
                 taken += req.n
                 batch.append(req)
             self._rows -= taken
-            if batch:
-                # Claim in-flight BEFORE the queue lock drops: an
-                # observer that sees pending_rows()==0 is then
-                # guaranteed to see this batch in inflight_batches(),
-                # so "pending==0 and inflight==0" really means drained
-                # (the bench's open-loop drain predicate).
+            segments = self._plan(batch)
+            if segments:
                 with self._inflight_lock:
-                    self._inflight += 1
-            return batch
+                    self._inflight += len(segments)
+            return segments
+
+    def _plan(self, batch: list[_Request]) -> list[list[_Request]]:
+        """The batch former: cut one drain into bucket-shaped dispatch
+        segments per the engine's measured cost table (scheduler.
+        plan_segments). No table (stub engines, pre-warmup routers) or
+        split=False means one segment — the covering-bucket dispatch."""
+        if not batch:
+            return []
+        counts = [len(batch)]
+        if self.split and len(batch) > 1:
+            costs_fn = getattr(self.engine, "bucket_costs", None)
+            costs = costs_fn() if callable(costs_fn) else None
+            if costs:
+                counts = plan_segments([r.n for r in batch],
+                                       self.engine.buckets, costs)
+        segments = []
+        off = 0
+        for c in counts:
+            segments.append(batch[off:off + c])
+            off += c
+        return segments
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -232,29 +311,39 @@ class DynamicBatcher:
             # window is full, arriving requests keep accumulating toward
             # a fuller batch instead of being split across dispatches.
             self._slots.acquire()
-            batch = self._take_batch()
-            if not batch:
+            segments = self._take_batch()
+            if not segments:
                 self._slots.release()
                 self._handles.put(None)      # completion shutdown
                 return
-            t0 = time.monotonic()
-            try:
-                handle = self.engine.dispatch([r.x for r in batch])
-            except Exception as e:   # fail the batch, keep serving
-                # failures fan out BEFORE the batch leaves the in-flight
-                # count — same drain invariant as the completion loop
-                for r in batch:
-                    r.future.set_exception(e)
+            for i, seg in enumerate(segments):
+                if i:
+                    # Later segments of a split drain each hold their
+                    # own window slot too (the completion thread frees
+                    # slots as earlier batches fan out, so this cannot
+                    # deadlock even at max_inflight=1) — the in-flight
+                    # bound stays an engine-side invariant under splits.
+                    self._slots.acquire()
+                t0 = time.monotonic()
+                try:
+                    handle = self.engine.dispatch([r.x for r in seg])
+                except Exception as e:   # fail the segment, keep serving
+                    # failures fan out BEFORE the segment leaves the
+                    # in-flight count — same drain invariant as the
+                    # completion loop; remaining segments still dispatch
+                    for r in seg:
+                        r.future.set_exception(e)
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                    self._slots.release()
+                    continue
                 with self._inflight_lock:
-                    self._inflight -= 1
-                self._slots.release()
-                continue
-            with self._inflight_lock:
-                depth = self._inflight
-            if self.metrics is not None:
-                self.metrics.record_dispatch(time.monotonic() - t0,
-                                             inflight=depth)
-            self._handles.put((batch, handle))
+                    self._dispatched += 1
+                    depth = self._dispatched
+                if self.metrics is not None:
+                    self.metrics.record_dispatch(time.monotonic() - t0,
+                                                 inflight=depth)
+                self._handles.put((seg, handle))
 
     def _completion_loop(self) -> None:
         while True:
@@ -270,10 +359,17 @@ class DynamicBatcher:
                     r.future.set_exception(e)
                 with self._inflight_lock:
                     self._inflight -= 1
+                    self._dispatched -= 1
                 self._slots.release()
                 continue
             t_done = time.monotonic()
             version = getattr(handle, "version", None)
+            if self.controller is not None:
+                # Feed the AIMD controller every request's end-to-end
+                # latency — violations step the effective wait down
+                # before this batch's futures even resolve.
+                for r in batch:
+                    self.controller.on_latency(t_done - r.t_enqueue)
             off = 0
             for r in batch:
                 # Attribution rides the future itself (set BEFORE
@@ -303,4 +399,5 @@ class DynamicBatcher:
             # invariant the bench and stop() rely on.
             with self._inflight_lock:
                 self._inflight -= 1
+                self._dispatched -= 1
             self._slots.release()

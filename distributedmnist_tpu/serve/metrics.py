@@ -52,6 +52,16 @@ class ServeMetrics:
             self._dispatches = 0
             self._inflight_sum = 0
             self._inflight_max = 0
+            # batch-former accounting (ISSUE 4): rows the engine
+            # actually executed (bucket slots) vs rows a client asked
+            # for — their gap is pure padding waste, the quantity the
+            # cost-model scheduler exists to shrink — plus the adaptive
+            # controller's effective coalescing wait gauge.
+            self._dispatched_rows = 0
+            self._padded_rows = 0
+            self._wait_last_s = None
+            self._wait_sum_s = 0.0
+            self._wait_n = 0
             # model-lifecycle split (ISSUE 3): per-version populations
             # (canary vs live separability) and shadow-comparison
             # aggregates. Keyed by the version labels the registry
@@ -105,10 +115,21 @@ class ServeMetrics:
             occ = self._occupancy.setdefault(bucket, [0, 0])
             occ[0] += 1
             occ[1] += rows
+            self._dispatched_rows += bucket
+            self._padded_rows += max(bucket - rows, 0)
             self._depth_sum += queue_depth
             self._depth_max = max(self._depth_max, queue_depth)
             if version is not None:
                 self._version_stats(version)["batches"] += 1
+
+    def record_wait(self, seconds: float) -> None:
+        """The effective coalescing wait the dispatch thread used for
+        one drain (the adaptive controller's current operating point,
+        == the static max_wait when adaptation is off)."""
+        with self._lock:
+            self._wait_last_s = seconds
+            self._wait_sum_s += seconds
+            self._wait_n += 1
 
     def record_reject(self, rows: int = 1) -> None:
         with self._lock:
@@ -163,6 +184,25 @@ class ServeMetrics:
                 "rows_per_sec": round(self._rows / elapsed, 2),
                 "latency_ms": lat_ms,
                 "batch_occupancy": occupancy,
+                # The scheduler's report card: executed bucket slots vs
+                # real rows (their ratio is the FLOP fraction burned on
+                # padding), the per-bucket dispatch histogram, and the
+                # effective-wait operating point.
+                "dispatched_rows": self._dispatched_rows,
+                "padded_rows": self._padded_rows,
+                "padding_waste_ratio": (
+                    round(self._padded_rows / self._dispatched_rows, 4)
+                    if self._dispatched_rows else None),
+                "bucket_dispatches": {
+                    str(b): n
+                    for b, (n, _) in sorted(self._occupancy.items())},
+                "effective_wait_us": {
+                    "last": (round(self._wait_last_s * 1e6, 1)
+                             if self._wait_n else None),
+                    "mean": (round(self._wait_sum_s / self._wait_n * 1e6,
+                                   1)
+                             if self._wait_n else None),
+                },
                 "mean_rows_per_batch": (
                     round(self._rows / self._batches, 2)
                     if self._batches else None),

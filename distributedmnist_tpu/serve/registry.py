@@ -70,6 +70,13 @@ class ModelVersion:
             "warmup_compile_events": self.warmup_compile_events,
             "warmup_s": round(self.warmup_s, 3),
             "loaded_at": round(self.loaded_at, 3),
+            # The warmup-measured per-bucket dispatch cost this
+            # version's batch former plans with (GET /models shows an
+            # operator what the scheduler believes about each program).
+            "bucket_cost_ms": ({
+                str(b): round(c * 1e3, 3)
+                for b, c in sorted(self.engine.bucket_costs().items())}
+                if self.engine is not None else None),
         }
 
 

@@ -207,6 +207,22 @@ class Router:
             return {t.version for t in (self._live, self._canary,
                                         self._shadow) if t is not None}
 
+    def bucket_costs(self) -> dict:
+        """The LIVE engine's measured per-bucket cost table (empty while
+        no version is live). Every resident engine shares one bucket
+        geometry, so the live table is a sound plan basis for canary
+        dispatches too; a promote atomically re-points this at the new
+        version's freshly re-measured costs — the registry's warmup
+        refreshes the table as part of making a version promotable."""
+        with self._lock:
+            live = self._live
+        if live is None:
+            return {}
+        costs = getattr(live.engine, "bucket_costs", None)
+        # engine-shaped doubles without a cost table plan as "don't
+        # split", same as a pre-warmup engine
+        return costs() if callable(costs) else {}
+
     # -- the engine surface the batcher drives ----------------------------
 
     def dispatch(self, x) -> RoutedHandle:

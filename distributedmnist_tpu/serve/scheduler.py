@@ -1,0 +1,281 @@
+"""SLO-aware adaptive batch scheduling: cost-model bucket packing and
+Clipper-style adaptive coalescing (ISSUE 4).
+
+Two pieces, both pure policy (no jax, no threads of their own), so the
+batcher stays the single owner of dispatch mechanics:
+
+- **plan_segments** — the batch former. The naive dispatch pads a whole
+  queue drain to its single smallest covering bucket, so a 9-row drain
+  runs the bucket-16 program and burns 44% of its FLOPs on padding. But
+  engine warmup MEASURES what each bucket's compiled program actually
+  costs (engine.bucket_costs), and Clockwork's observation applies: once
+  per-program costs are known and stable, the scheduler should exploit
+  them. plan_segments partitions one FIFO drain into several
+  bucket-shaped dispatches whenever the cost table says split beats pad
+  (20 rows -> 16+4 instead of 32), feeding the pipelined in-flight
+  window several right-sized batches instead of one oversized padded
+  one. Requests are never split across dispatches (a request's future
+  resolves from exactly one fetch), and FIFO order is preserved, so the
+  only degree of freedom is WHERE to cut — an exact dynamic program
+  over request boundaries, O(requests x buckets) via the
+  furthest-fill-per-bucket reduction.
+
+- **AdaptiveController** — the coalescing-wait controller. A fixed
+  max_wait_us is wrong at both ends of the load curve: too long when
+  the system is violating its SLO (queueing delay it can't afford), too
+  short when there is latency headroom that could buy occupancy.
+  Clipper's AIMD batch-parameter search, applied to the wait knob:
+  multiplicative step-DOWN of the effective wait on every observed SLO
+  violation, small additive creep-UP after a window of comfortably
+  under-SLO requests. The configured max_wait_us stays a hard cap, the
+  floor is zero wait (one-row immediacy) — the controller can never
+  push latency ABOVE the static configuration, only trade within it.
+  An arrival-rate EWMA additionally caps the wait at the time the
+  current rate needs to FILL max_batch rows: waiting longer than the
+  fill time buys nothing at any load.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from bisect import bisect_right
+from typing import Mapping, Optional, Sequence
+
+
+def fit_dispatch_cost(costs: Mapping[int, float]) -> tuple[float, float]:
+    """Least-squares affine fit of a measured bucket-cost ladder:
+    cost(b) ~= overhead + per_row * b, both clamped non-negative.
+
+    Raw per-bucket medians carry timing noise comparable to the gap
+    between ADJACENT rungs (a 2-row program and a 4-row program are the
+    same microseconds of compute behind ~ms of dispatch overhead), so
+    comparing raw entries at the margin plans on noise. The affine fit
+    pools the whole ladder into the two quantities that actually govern
+    split-vs-pad: what one more DISPATCH costs (overhead — the case
+    against splitting) and what one more BUCKET ROW costs (per_row —
+    the price of padding, the case for it). Returns (overhead_s,
+    per_row_s)."""
+    bs = sorted(costs)
+    n = len(bs)
+    if n == 0:
+        raise ValueError("empty cost table")
+    ys = [max(costs[b], 0.0) for b in bs]
+    if n == 1:
+        return ys[0], 0.0
+    mx = sum(bs) / n
+    my = sum(ys) / n
+    sxx = sum((x - mx) ** 2 for x in bs)
+    sxy = sum((x - mx) * (y - my) for x, y in zip(bs, ys))
+    per_row = max(sxy / sxx, 0.0) if sxx else 0.0
+    overhead = max(my - per_row * mx, 0.0)
+    return overhead, per_row
+
+
+# One-slot fit memo: the cost table only ever changes by whole-reference
+# swap (engine.warmup / a promote re-pointing the live engine), but
+# plan_segments runs once per queue drain on the dispatch hot path —
+# re-fitting identical data up to ~1000x/sec between swaps is pure
+# waste. Keyed on table identity + ladder; a stale-read race just
+# recomputes (single atomic tuple assignment, no lock needed).
+_fit_memo = None   # (costs_obj, buckets_tuple, (overhead_s, per_row_s))
+
+
+def _fitted(costs: Mapping[int, float],
+            buckets: Sequence[int]) -> tuple[float, float]:
+    global _fit_memo
+    memo = _fit_memo
+    bkey = tuple(buckets)
+    if memo is not None and memo[0] is costs and memo[1] == bkey:
+        return memo[2]
+    fit = fit_dispatch_cost({b: costs[b] for b in buckets})
+    _fit_memo = (costs, bkey, fit)
+    return fit
+
+
+def plan_segments(sizes: Sequence[int], buckets: Sequence[int],
+                  costs: Mapping[int, float],
+                  pad_bias: float = 2.0) -> list[int]:
+    """Partition a FIFO drain into contiguous dispatch segments.
+
+    `sizes` are the per-request row counts of one coalesced drain, in
+    queue order; `buckets` the engine's ascending bucket ladder; `costs`
+    the measured seconds-per-dispatch of each bucket's compiled program
+    (engine.bucket_costs() — end-to-end infer time, so per-dispatch host
+    overhead is priced in, not assumed away). A dispatch into bucket b
+    carrying r real rows is priced off the ladder's affine fit
+    (fit_dispatch_cost):
+
+        overhead + per_row * (r + pad_bias * (b - r))
+
+    i.e. a PADDED row costs pad_bias x a real row's fitted compute.
+    pad_bias=1 is pure modeled wall-clock; the default 2 leans the
+    near-tie decisions toward less padding, because a padded row does
+    not just burn its own compute — under sustained load it displaces a
+    real row from the same finite dispatch budget (the padding-waste
+    FLOPs are the capacity the scheduler exists to reclaim), and on a
+    noisy host the fitted costs of split-vs-pad near-ties sit inside
+    timing noise anyway. Returns request counts per segment
+    (sum == len(sizes)); [len(sizes)] means "don't split".
+
+    Exact DP over request boundaries (a request's rows can never span
+    two dispatches — its future resolves from exactly one fetch):
+    dp[j] = min cost to dispatch the first j requests. From position i
+    each bucket b reaches at most the furthest j with rows(i..j) <= b —
+    filling a bucket with MORE requests at the same cost can never hurt
+    (any later plan over the leftovers only shrinks), so only the
+    furthest fill per bucket needs relaxing. Ties break toward FEWER
+    segments: equal modeled cost must not churn extra dispatches.
+    """
+    k = len(sizes)
+    if k <= 1:
+        return [k] if k else []
+    if any(b not in costs for b in buckets):
+        # No confident cost model (e.g. a stub engine, or pre-warmup):
+        # fall back to the single covering dispatch.
+        return [k]
+    overhead, per_row = _fitted(costs, buckets)
+    prefix = [0]
+    for s in sizes:
+        prefix.append(prefix[-1] + s)
+    INF = (math.inf, math.inf)
+    dp: list[tuple] = [INF] * (k + 1)     # (cost, n_segments)
+    back = [0] * (k + 1)
+    dp[0] = (0.0, 0)
+    for i in range(k):
+        if dp[i] is INF:
+            continue
+        cost_i, segs_i = dp[i]
+        for b in buckets:
+            j = bisect_right(prefix, prefix[i] + b) - 1
+            if j <= i:
+                continue                  # bucket can't carry request i
+            rows = prefix[j] - prefix[i]
+            seg_cost = overhead + per_row * (
+                rows + pad_bias * (b - rows))
+            cand = (cost_i + seg_cost, segs_i + 1)
+            if cand < dp[j]:
+                dp[j] = cand
+                back[j] = i
+    if dp[k] is INF:
+        # A request larger than the top bucket can't be planned; the
+        # engine's own bucket_for would reject it too. Don't split.
+        return [k]
+    cuts = []
+    j = k
+    while j > 0:
+        cuts.append(j)
+        j = back[j]
+    cuts.append(0)
+    cuts.reverse()
+    return [b - a for a, b in zip(cuts, cuts[1:])]
+
+
+class AdaptiveController:
+    """AIMD effective-wait controller + arrival-rate EWMA (thread-safe).
+
+    `on_arrival` is called by every accepted submit, `on_latency` with
+    every request's end-to-end latency at fan-out; `effective_wait_s`
+    is read once per drain by the dispatch thread. With no SLO
+    configured the AIMD half is inert and the effective wait is the
+    static max_wait_s (minus the fill-time cap) — the controller is
+    always safe to leave in the loop.
+    """
+
+    def __init__(self, max_wait_s: float, slo_s: Optional[float] = None,
+                 max_batch: Optional[int] = None, headroom: float = 0.8,
+                 decrease: float = 0.5, increase_frac: float = 0.05,
+                 window: int = 32, rate_tau_s: float = 1.0):
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        if slo_s is not None and slo_s <= 0:
+            raise ValueError(f"slo_s must be > 0, got {slo_s}")
+        if not 0 < decrease < 1:
+            raise ValueError(f"decrease must be in (0,1), got {decrease}")
+        self.max_wait_s = float(max_wait_s)
+        self.slo_s = slo_s
+        self.max_batch = max_batch
+        self.headroom = headroom
+        self.decrease = decrease
+        self.increase_s = increase_frac * self.max_wait_s
+        self.window = window
+        self.rate_tau_s = rate_tau_s
+        self._lock = threading.Lock()
+        self._wait_s = self.max_wait_s    # start at the configured point
+        self._rate = 0.0                  # rows/sec EWMA
+        self._t_last: Optional[float] = None
+        self._win_n = 0                   # under-SLO samples this window
+        self._win_max = 0.0
+        self._violations = 0
+        self._increases = 0
+
+    # -- inputs ------------------------------------------------------------
+
+    def on_arrival(self, rows: int = 1, now: Optional[float] = None
+                   ) -> None:
+        """One accepted request of `rows` rows; feeds the arrival-rate
+        EWMA (irregular-interval exponential decay, tau=rate_tau_s)."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if self._t_last is None:
+                self._t_last = now
+                return
+            dt = max(now - self._t_last, 1e-9)
+            self._t_last = now
+            decay = math.exp(-dt / self.rate_tau_s)
+            self._rate = decay * self._rate + (1.0 - decay) * (rows / dt)
+
+    def on_latency(self, seconds: float) -> None:
+        """One request's end-to-end latency. AIMD: a violation halves
+        the effective wait immediately (and restarts the headroom
+        window); `window` consecutive under-SLO samples whose max sits
+        below headroom*SLO earn one additive step back up, never past
+        the max_wait_s hard cap."""
+        if self.slo_s is None:
+            return
+        with self._lock:
+            if seconds > self.slo_s:
+                self._wait_s *= self.decrease
+                self._violations += 1
+                self._win_n = 0
+                self._win_max = 0.0
+                return
+            self._win_n += 1
+            self._win_max = max(self._win_max, seconds)
+            if self._win_n >= self.window:
+                if self._win_max < self.headroom * self.slo_s:
+                    self._wait_s = min(self.max_wait_s,
+                                       self._wait_s + self.increase_s)
+                    self._increases += 1
+                self._win_n = 0
+                self._win_max = 0.0
+
+    # -- outputs -----------------------------------------------------------
+
+    def arrival_rate(self) -> float:
+        with self._lock:
+            return self._rate
+
+    def effective_wait_s(self) -> float:
+        """The coalescing wait the next drain should use: the AIMD
+        point, capped by the time the current arrival rate needs to
+        fill max_batch rows, clamped into [0, max_wait_s]."""
+        with self._lock:
+            w = self._wait_s
+            if self.max_batch and self._rate > 0:
+                w = min(w, self.max_batch / self._rate)
+            return min(max(w, 0.0), self.max_wait_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "slo_ms": (round(self.slo_s * 1e3, 3)
+                           if self.slo_s is not None else None),
+                "max_wait_us": round(self.max_wait_s * 1e6, 1),
+                "aimd_wait_us": round(self._wait_s * 1e6, 1),
+                "arrival_rate_rows_per_sec": round(self._rate, 1),
+                "violations": self._violations,
+                "increases": self._increases,
+            }

@@ -50,7 +50,11 @@ Model/params come from Config: --checkpoint-dir restores trained params
 (params-only — no optimizer slots are read for serving); otherwise
 params are fresh-init (load tests). Batching knobs: --serve-max-batch,
 --serve-max-wait-us, --serve-queue-depth, --serve-max-inflight
-(config.py); --serve-max-versions bounds resident warmed versions.
+(config.py); --serve-max-versions bounds resident warmed versions;
+--serve-slo-ms arms the SLO-aware adaptive coalescing controller and
+--no-adaptive pins the static wait (serve/scheduler.py — the cost-model
+batch former is always on; it degrades to single-dispatch when the cost
+table is absent).
 --request-timeout bounds how long an HTTP client thread may wait on its
 future before a 504 — a wedged dispatch pipeline must shed its waiters,
 not hold ThreadingHTTPServer threads forever.
@@ -197,7 +201,22 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 code, payload = state.healthz(registry, batcher)
                 self._send(code, payload)
             elif self.path == "/metrics":
-                self._send(200, metrics.record())
+                # The full ServeMetrics snapshot PLUS point-in-time
+                # pipeline gauges and the adaptive controller's state —
+                # the operator's one-stop view, so nobody has to scrape
+                # the stdout heartbeat lines for queue depth or the
+                # current effective wait.
+                payload = metrics.record()
+                payload["queue"] = {
+                    "pending_rows": batcher.pending_rows(),
+                    "inflight_batches": batcher.inflight_batches(),
+                    "max_inflight": batcher.max_inflight,
+                    "queue_depth_watermark": batcher.queue_depth,
+                }
+                payload["adaptive"] = (
+                    batcher.controller.snapshot()
+                    if batcher.controller is not None else None)
+                self._send(200, payload)
             elif self.path == "/models":
                 self._send(200, registry.describe())
             else:
@@ -432,6 +451,8 @@ def main(argv=None) -> int:
         p.error("--serve-max-inflight must be >= 1")
     if args.serve_max_versions is not None and args.serve_max_versions < 2:
         p.error("--serve-max-versions must be >= 2 (live + a candidate)")
+    if args.serve_slo_ms is not None and args.serve_slo_ms <= 0:
+        p.error("--serve-slo-ms must be > 0")
     cfg = config_lib.from_args(args)
 
     from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
@@ -443,6 +464,8 @@ def main(argv=None) -> int:
                              max_wait_us=cfg.serve_max_wait_us,
                              queue_depth=cfg.serve_queue_depth,
                              max_inflight=cfg.serve_max_inflight,
+                             slo_ms=cfg.serve_slo_ms,
+                             adaptive=cfg.serve_adaptive,
                              metrics=metrics).start()
     log.info("dispatch pipeline depth: %d; buckets %s",
              batcher.max_inflight, list(factory.buckets))

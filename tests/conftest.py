@@ -72,6 +72,41 @@ def wait_for_committed_checkpoint(ckpt_dir: str, procs,
     pytest.fail("no checkpoint committed within the deadline")
 
 
+@pytest.fixture(autouse=True)
+def serve_thread_hygiene(request):
+    """Fail any serve test that leaks a LIVE NON-DAEMON thread: the
+    serving stack spins up dispatch/completion/shadow/warm threads, and
+    a batcher or registry rewrite that forgets daemon=True (or loses a
+    join) would otherwise strand threads silently — discovered only
+    when a whole pytest process hangs at exit. Daemon threads are
+    exempt: several serving threads (e.g. the shadow drain loop) are
+    intentionally daemonic and park forever by design. A short grace
+    window lets orderly stop() teardowns finish winding down."""
+    import time as _time
+
+    import threading
+
+    if "test_serve" not in os.path.basename(str(request.node.fspath)):
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t not in before and t.is_alive() and not t.daemon]
+
+    deadline = _time.monotonic() + 5.0
+    while leaked() and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    bad = leaked()
+    if bad:
+        pytest.fail(
+            "serve test leaked live non-daemon thread(s): "
+            f"{[t.name for t in bad]} — dispatch/completion/shadow "
+            "threads must be daemons and/or joined by stop()")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

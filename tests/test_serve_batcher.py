@@ -30,13 +30,17 @@ class StubEngine:
 
     platform = "cpu"
 
-    def __init__(self, max_batch=16, n_chips=4, gate=None):
+    def __init__(self, max_batch=16, n_chips=4, gate=None, costs=None):
         self.max_batch = max_batch
         self.buckets = tuple(n_chips * 2 ** i for i in range(
             max(1, (max_batch // n_chips).bit_length())))
         while self.buckets[-1] < max_batch:
             self.buckets += (self.buckets[-1] * 2,)
         self.gate = gate
+        # Optional per-bucket cost table: None (the default) means the
+        # batch former sees no cost model and never splits, so the
+        # pre-ISSUE-4 tests exercise exactly the single-dispatch path.
+        self.costs = costs
         self.calls = []            # row counts per dispatch() call
         self.in_call = threading.Event()  # set on every dispatch()
         self.inflight = 0
@@ -44,6 +48,14 @@ class StubEngine:
         self._lock = threading.Lock()
 
     _as_images = staticmethod(InferenceEngine._as_images)
+
+    def bucket_costs(self):
+        return self.costs or {}
+
+    def linear_costs(self):
+        """Compute-priced buckets (cost proportional to rows): the
+        regime where the batch former always prefers split over pad."""
+        return {b: b * 1e-3 for b in self.buckets}
 
     def bucket_for(self, n):
         for b in self.buckets:
@@ -326,3 +338,162 @@ def test_metrics_record_occupancy_and_latency(rng):
     assert sum(v["rows"] for v in occ.values()) == 8
     for v in occ.values():
         assert 0 < v["occupancy"] <= 1
+
+
+# -- ISSUE 4: cost-model batch former + adaptive coalescing ---------------
+
+
+def _gated_drain(rng, eng, b, sizes):
+    """Occupy the (single-slot) pipeline with a 1-row dispatch wedged at
+    the fetch gate, queue `sizes` behind it, then release the gate —
+    the queued requests coalesce into ONE drain that the batch former
+    plans. Returns the (x, future) pairs of the queued requests."""
+    first = b.submit(_rows(rng, 1))
+    assert eng.in_call.wait(timeout=10)
+    xs = [_rows(rng, n) for n in sizes]
+    futs = [b.submit(x) for x in xs]
+    eng.gate.set()
+    first.result(timeout=10)
+    return list(zip(xs, futs))
+
+
+def test_batch_former_splits_one_drain_into_bucket_shaped_dispatches(rng):
+    """With a compute-priced cost table, a 20-row drain dispatches as
+    16+4 (the ISSUE example), not one padded 32 — and every request
+    still gets exactly its own rows back, in order, across the split."""
+    eng = StubEngine(max_batch=32)
+    eng.costs = eng.linear_costs()
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=256).start()
+    try:
+        pairs = _gated_drain(rng, eng, b, [4, 4, 4, 4, 4])
+        for x, f in pairs:
+            want = x.reshape(x.shape[0], -1)[:, :10].astype(np.float32)
+            np.testing.assert_array_equal(f.result(timeout=10), want)
+    finally:
+        b.stop()
+    assert eng.calls[0] == 1
+    assert sorted(eng.calls[1:]) == [4, 16], (
+        f"expected a 16+4 split dispatch, got {eng.calls}")
+
+
+def test_split_disabled_restores_single_covering_dispatch(rng):
+    """split=False is the escape hatch: the same drain that would split
+    under the cost table goes out as one dispatch."""
+    eng = StubEngine(max_batch=32)
+    eng.costs = eng.linear_costs()
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=256,
+                       split=False).start()
+    try:
+        pairs = _gated_drain(rng, eng, b, [4, 4, 4, 4, 4])
+        for _, f in pairs:
+            f.result(timeout=10)
+    finally:
+        b.stop()
+    assert eng.calls == [1, 20], eng.calls
+
+
+def test_padding_accounting_exact_under_split_dispatches(rng):
+    """The ISSUE 4 accounting contract: over a stream of split and
+    unsplit dispatches, the metrics' padded/dispatched row counters
+    equal the per-dispatch sums reconstructed from the engine's own
+    call log — no double count, no leak, and the waste ratio is their
+    quotient."""
+    metrics = ServeMetrics()
+    eng = StubEngine(max_batch=32)
+    eng.costs = eng.linear_costs()
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=4096,
+                       metrics=metrics).start()
+    try:
+        pairs = _gated_drain(rng, eng, b, [3, 4, 4, 4, 4])
+        for _, f in pairs:
+            f.result(timeout=10)
+        # a second, unsplittable lone request pads to its bucket
+        b.submit(_rows(rng, 5)).result(timeout=10)
+    finally:
+        b.stop()
+    snap = metrics.snapshot()
+    dispatched = sum(eng.bucket_for(c) for c in eng.calls)
+    padded = sum(eng.bucket_for(c) - c for c in eng.calls)
+    assert snap["dispatched_rows"] == dispatched
+    assert snap["padded_rows"] == padded
+    assert snap["padding_waste_ratio"] == round(padded / dispatched, 4)
+    assert sum(snap["bucket_dispatches"].values()) == len(eng.calls)
+    assert snap["batches"] == len(eng.calls)
+    # the depth gauge counts DISPATCHED segments only: a split drain's
+    # popped-but-undispatched tail must not read as phantom overlap on
+    # this serial (max_inflight=1) pipeline
+    assert snap["inflight_max"] <= 1
+
+
+def test_stop_drain_resolves_popped_but_undispatched_segments(rng):
+    """The PR 2 drain hole, audited for the batch former: stop(drain=
+    True) lands while a split drain's later segments are popped off the
+    queue but NOT yet dispatched (the single window slot is held by a
+    wedged fetch). Every accepted future must still resolve with its
+    own rows."""
+    eng = StubEngine(max_batch=32)
+    eng.costs = eng.linear_costs()
+    gate = threading.Event()
+    eng.gate = gate
+    b = DynamicBatcher(eng, max_wait_us=20_000, queue_depth=256,
+                       max_inflight=1).start()
+    first = b.submit(_rows(rng, 1))
+    assert eng.in_call.wait(timeout=10)
+    # queued behind the wedged fetch; will coalesce into one drain that
+    # the former splits into >= 2 segments
+    xs = [_rows(rng, n) for n in (4, 4, 4, 4, 4)]
+    futs = [b.submit(x) for x in xs]
+    # let the dispatch thread pop + plan the drain, then stop while its
+    # later segments are still waiting on the window slot
+    threading.Timer(0.3, gate.set).start()
+    time.sleep(0.1)
+    b.stop(drain=True)
+    first.result(timeout=0)
+    for x, f in zip(xs, futs):
+        want = x.reshape(x.shape[0], -1)[:, :10].astype(np.float32)
+        np.testing.assert_array_equal(f.result(timeout=0), want)
+    assert b.pending_rows() == 0 and b.inflight_batches() == 0
+    assert len(eng.calls) >= 3, eng.calls      # 1-row + a split drain
+
+
+def test_effective_wait_gauge_recorded(rng):
+    metrics = ServeMetrics()
+    eng = StubEngine(max_batch=16)
+    b = DynamicBatcher(eng, max_wait_us=5000, queue_depth=64,
+                       metrics=metrics).start()
+    try:
+        for _ in range(3):
+            b.submit(_rows(rng, 2)).result(timeout=10)
+    finally:
+        b.stop()
+    gauge = metrics.snapshot()["effective_wait_us"]
+    assert gauge["last"] is not None and gauge["last"] <= 5000
+    assert gauge["mean"] is not None
+
+
+def test_adaptive_controller_wired_end_to_end(rng):
+    """A microsecond SLO makes every served request a violation: the
+    batcher-fed controller must step the effective wait down from the
+    configured cap, and the violation count must show up in its
+    snapshot. --no-adaptive (adaptive=False) must leave no controller
+    in the loop at all."""
+    eng = StubEngine(max_batch=16)
+    b = DynamicBatcher(eng, max_wait_us=10_000, queue_depth=64,
+                       slo_ms=0.001).start()
+    try:
+        for _ in range(4):
+            b.submit(_rows(rng, 2)).result(timeout=10)
+    finally:
+        b.stop()
+    snap = b.controller.snapshot()
+    assert snap["violations"] >= 4
+    assert b.controller.effective_wait_s() < 10_000 / 1e6
+    assert DynamicBatcher(eng, adaptive=False).controller is None
+    with pytest.raises(ValueError, match="slo_ms"):
+        DynamicBatcher(eng, slo_ms=0)

@@ -76,6 +76,27 @@ def test_bench_serve_contract():
     assert point["latency_ms"]["p99"] is not None
     assert point["img_s_chip"] > 0
     assert d["buckets"] == [8, 16]
+    # the warmup-measured cost table rides the record (the batch
+    # former's price list), one entry per bucket
+    assert sorted(int(k) for k in d["bucket_cost_ms"]) == d["buckets"]
+    assert all(v > 0 for v in d["bucket_cost_ms"].values())
+    assert d["adaptive"] is True and d["slo_ms"] is None
+    assert closed["effective_wait_us"]["last"] is not None
+    # the ragged-arrival leg ran both former sub-phases and carries the
+    # waste/goodput comparison (the >=2x acceptance bar applies to the
+    # full-ladder CPU/TPU hosts, not this 2-bucket mini config — here
+    # only the structure and accounting are asserted)
+    rag = d["ragged"]
+    assert rag["sizes"] == "uniform[1..16]"     # capped at max_batch
+    assert rag["coalesce_wait_us"] >= 2000
+    for sub in ("former_off", "former_on"):
+        for leg in ("closed", "open"):
+            s = rag[sub][leg]
+            assert s["padding_waste_ratio"] is not None
+            assert s["dispatched_rows"] >= s["padded_rows"] >= 0
+            assert s["rows_per_sec"] > 0
+    assert rag["closed_waste_reduction_x"] is not None
+    assert rag["closed_goodput_ratio"] is not None
     # the serial-vs-pipelined comparison is measured, not claimed
     cmp = d["inflight_comparison"]
     assert cmp["serial_img_s_chip"] > 0
@@ -143,6 +164,50 @@ def test_bench_serve_inflight_flag_validated():
     out = _run_cli("bench.py", ["smoke", "--serve-max-inflight", "2"],
                    timeout=60)
     assert out.returncode == 2
+
+
+def test_bench_serve_baseline_flag_validated(tmp_path):
+    """--baseline usage errors exit 2 before any backend comes up: an
+    unreadable file, a record without host provenance (pre-PR 3
+    artifacts can't be safely compared), and use outside serve mode."""
+    out = _run_cli("bench.py", ["serve", "--baseline", "/nope.json"],
+                   timeout=60)
+    assert out.returncode == 2
+    old = tmp_path / "old.json"
+    for detail in ({}, None, "not-a-dict", {"host": None}):
+        old.write_text(json.dumps({"metric": "serve", "value": 1.0,
+                                   "detail": detail}))
+        out = _run_cli("bench.py", ["serve", "--baseline", str(old)],
+                       timeout=60)
+        assert out.returncode == 2, detail
+        assert "device_kind" in out.stderr, detail
+    out = _run_cli("bench.py", ["smoke", "--baseline", str(old)],
+                   timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("bench.py", ["serve", "--serve-slo-ms", "0"],
+                   timeout=60)
+    assert out.returncode == 2
+    # --no-adaptive is serve-only, like every other --serve knob
+    out = _run_cli("bench.py", ["throughput", "--no-adaptive"],
+                   timeout=60)
+    assert out.returncode == 2
+
+
+def test_bench_serve_baseline_device_kind_mismatch_refused(tmp_path):
+    """The ROADMAP warning, mechanized: a baseline measured on different
+    silicon is refused with a nonzero exit BEFORE any load phase — a
+    CPU host must not print a delta table against a TPU record."""
+    base = tmp_path / "BENCH_serve_r99.json"
+    base.write_text(json.dumps({
+        "metric": "serve_images_per_sec_per_chip", "value": 12345.0,
+        "detail": {"host": {"device_kind": "TPU v99"},
+                   "recompiles_after_warmup": 0,
+                   "closed_loop": {"latency_ms": {"p99": 1.0}}}}))
+    out = _run_cli("bench.py",
+                   ["serve", "--baseline", str(base)] + SERVE_ARGS)
+    assert out.returncode == 4, (out.returncode, out.stderr[-500:])
+    assert "REFUSING" in out.stderr and "TPU v99" in out.stderr
+    assert not out.stdout.strip(), "refusal must not emit a record"
 
 
 def test_serve_request_timeout_flag_validated():
@@ -246,6 +311,15 @@ def test_serve_http_end_to_end():
             f"{base}/metrics", timeout=10).read())
         assert m["metric"] == "serve_stats" and m["requests"] >= 1
         assert r["version"] in m["by_version"]
+        # the operator snapshot carries live pipeline gauges and the
+        # adaptive controller's state, not just window counters
+        q = m["queue"]
+        assert q["pending_rows"] >= 0 and q["inflight_batches"] >= 0
+        assert q["max_inflight"] >= 1 and q["queue_depth_watermark"] >= 1
+        assert m["adaptive"]["aimd_wait_us"] > 0    # default: adaptive on
+        assert m["padding_waste_ratio"] is not None
+        assert m["bucket_dispatches"]
+        assert m["effective_wait_us"]["last"] is not None
 
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(f"{base}/predict", data=b"not-784",
@@ -306,6 +380,8 @@ def test_serve_admin_model_lifecycle(tmp_path):
         assert models_view["routes"]["live"] == boot
         assert [v["version"] for v in models_view["versions"]] == [boot]
         assert models_view["versions"][0]["source"] == "fresh-init"
+        # the warmup-measured cost table is surfaced per version
+        assert models_view["versions"][0]["bucket_cost_ms"]
 
         # roll 1: explicit admin load + promote
         _save_mlp_checkpoint(ckpt_dir, step=5)

@@ -1,0 +1,173 @@
+"""serve/scheduler.py: the cost-model batch former (split-vs-pad DP over
+request boundaries) and the Clipper-style AIMD adaptive-coalescing
+controller — pure policy, tested with synthetic cost tables and
+synthetic latency/arrival streams, no jax."""
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve.scheduler import (AdaptiveController,
+                                                  fit_dispatch_cost,
+                                                  plan_segments)
+
+BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+# Compute-dominated silicon: cost proportional to bucket rows, no
+# per-dispatch overhead — the regime where splitting always pays.
+LINEAR = {b: b * 1e-3 for b in BUCKETS}
+# Overhead-dominated host: every dispatch costs ~the same regardless of
+# rows — the regime where splitting NEVER pays.
+FLAT = {b: 1e-3 for b in BUCKETS}
+
+
+def _covering(n, buckets=BUCKETS):
+    return next(b for b in buckets if b >= n)
+
+
+def _segment_rows(sizes, counts):
+    out, off = [], 0
+    for c in counts:
+        out.append(sum(sizes[off:off + c]))
+        off += c
+    return out
+
+
+def test_fit_dispatch_cost_recovers_affine_model():
+    o, m = fit_dispatch_cost({b: 2e-3 + 0.5e-3 * b for b in BUCKETS})
+    assert o == pytest.approx(2e-3, rel=1e-6)
+    assert m == pytest.approx(0.5e-3, rel=1e-6)
+    o, m = fit_dispatch_cost(FLAT)
+    assert o == pytest.approx(1e-3) and m == 0.0
+    # negative slopes/intercepts are measurement noise: clamped, never
+    # propagated into the planner as "bigger batches are cheaper"
+    o, m = fit_dispatch_cost({1: 5e-3, 128: 1e-3})
+    assert m == 0.0 and o >= 0.0
+    with pytest.raises(ValueError):
+        fit_dispatch_cost({})
+
+
+def test_plan_splits_when_cost_table_says_split_beats_pad():
+    """The ISSUE example: a 20-row drain on compute-priced buckets runs
+    16+4, not one padded 32."""
+    counts = plan_segments([4, 4, 4, 4, 4], BUCKETS, LINEAR)
+    assert sum(counts) == 5 and len(counts) == 2
+    assert sorted(_segment_rows([4] * 5, counts)) == [4, 16]
+
+
+def test_plan_never_splits_on_flat_costs():
+    """Overhead-dominated table: one extra dispatch always costs more
+    than any padding it saves — the planner must keep the single
+    covering dispatch."""
+    assert plan_segments([4, 4, 4, 4, 4], BUCKETS, FLAT) == [5]
+    assert plan_segments([1] * 20, BUCKETS, FLAT) == [20]
+
+
+def test_plan_respects_request_boundaries():
+    """A request's rows can never span two dispatches: every cut in the
+    returned plan falls between requests, whatever the sizes."""
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        sizes = [int(n) for n in rng.integers(1, 21, rng.integers(1, 12))]
+        counts = plan_segments(sizes, BUCKETS, LINEAR)
+        assert all(c >= 1 for c in counts)
+        assert sum(counts) == len(sizes)
+        # every segment fits its covering bucket (the dispatch the
+        # batcher will actually issue)
+        for rows in _segment_rows(sizes, counts):
+            assert rows <= BUCKETS[-1]
+
+
+def test_plan_split_reduces_padding_on_linear_costs():
+    """On compute-priced buckets the planned dispatches burn strictly
+    fewer padded rows than the naive covering bucket whenever a split
+    exists."""
+    sizes = [12, 9, 20, 15, 8, 11, 9]          # 84 rows -> covering 128
+    counts = plan_segments(sizes, BUCKETS, LINEAR)
+    assert len(counts) > 1
+    planned_pad = sum(_covering(r) - r
+                      for r in _segment_rows(sizes, counts))
+    naive_pad = _covering(sum(sizes)) - sum(sizes)
+    assert planned_pad < naive_pad
+
+
+def test_plan_degenerate_and_fallback_cases():
+    assert plan_segments([], BUCKETS, LINEAR) == []
+    assert plan_segments([7], BUCKETS, LINEAR) == [1]
+    # a cost table missing any rung is no cost model at all
+    partial = dict(LINEAR)
+    del partial[32]
+    assert plan_segments([4, 4, 4, 4, 4], BUCKETS, partial) == [5]
+
+
+def test_plan_pad_bias_flips_near_ties_toward_less_padding():
+    """pad_bias prices padded rows above real ones: a near-tie (one
+    extra dispatch's overhead vs a handful of padded rows) pads at
+    bias 1 and splits at the default bias 2."""
+    costs = {b: 5e-3 + 0.5e-3 * b for b in BUCKETS}   # o = 10m
+    sizes = [12, 8]        # 20 rows: 32 pads 12; 16+8 costs one more o
+    assert plan_segments(sizes, BUCKETS, costs, pad_bias=1.0) == [2]
+    assert plan_segments(sizes, BUCKETS, costs, pad_bias=2.0) == [1, 1]
+
+
+def test_aimd_moves_both_directions_within_hard_bounds():
+    """The acceptance contract: SLO violations step the effective wait
+    DOWN (multiplicative), sustained headroom steps it back UP
+    (additive) — and at no point does the wait exceed the configured
+    hard cap or go below zero (one-row immediacy)."""
+    cap = 1e-3
+    c = AdaptiveController(cap, slo_s=0.05, window=4)
+    assert c.effective_wait_s() == cap          # starts at the cap
+    # a synthetic violation stream: monotone decrease, floored at 0
+    seen = [c.effective_wait_s()]
+    for _ in range(200):
+        c.on_latency(0.06)
+        w = c.effective_wait_s()
+        assert 0.0 <= w <= cap
+        assert w <= seen[-1]
+        seen.append(w)
+    assert seen[-1] < 1e-6                      # collapsed to immediacy
+    assert c.snapshot()["violations"] == 200
+    # sustained comfortable headroom: creeps back up, capped
+    for _ in range(500):
+        c.on_latency(0.001)
+        assert c.effective_wait_s() <= cap
+    assert c.effective_wait_s() == cap          # fully recovered
+    assert c.snapshot()["increases"] > 0
+
+
+def test_aimd_headroom_requires_comfort_not_just_compliance():
+    """Samples under the SLO but above the headroom fraction must NOT
+    creep the wait up — barely-compliant latency is not an invitation
+    to batch harder."""
+    c = AdaptiveController(1e-3, slo_s=0.05, window=4, headroom=0.8)
+    c.on_latency(0.06)                          # step down once
+    w = c.effective_wait_s()
+    for _ in range(100):
+        c.on_latency(0.045)                     # compliant, no headroom
+    assert c.effective_wait_s() == w
+
+
+def test_arrival_rate_ewma_and_fill_time_cap():
+    """The arrival-rate EWMA tracks a synthetic steady stream, and the
+    fill-time cap bounds the effective wait at the time that rate needs
+    to fill max_batch rows — waiting longer buys nothing."""
+    c = AdaptiveController(0.05, max_batch=16)
+    t = 0.0
+    for _ in range(5000):                       # 1 row per ms = 1000/s
+        c.on_arrival(1, now=t)
+        t += 1e-3
+    assert c.arrival_rate() == pytest.approx(1000.0, rel=0.05)
+    # fill time = 16 rows / 1000 rows/s = 16 ms < the 50 ms static wait
+    assert c.effective_wait_s() == pytest.approx(0.016, rel=0.1)
+    # no SLO: on_latency is a no-op, the AIMD point never moves
+    c.on_latency(99.0)
+    assert c.snapshot()["violations"] == 0
+    assert c.snapshot()["aimd_wait_us"] == pytest.approx(50_000.0)
+
+
+def test_controller_validates_arguments():
+    with pytest.raises(ValueError, match="max_wait_s"):
+        AdaptiveController(-1.0)
+    with pytest.raises(ValueError, match="slo_s"):
+        AdaptiveController(1e-3, slo_s=0.0)
+    with pytest.raises(ValueError, match="decrease"):
+        AdaptiveController(1e-3, slo_s=0.1, decrease=1.5)
