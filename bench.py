@@ -174,6 +174,15 @@ def main(argv=None) -> int:
                         "(nonzero exit) when detail.host.device_kind "
                         "differs — CPU records must never masquerade as "
                         "TPU headlines (ROADMAP)")
+    p.add_argument("--chaos", action="store_true", default=None,
+                   help="[serve] add the resilience leg: a seeded "
+                        "fault-injection schedule (>=1%% request-sticky "
+                        "poison dispatch faults + a forced mid-run "
+                        "circuit-breaker trip) driven open-loop, "
+                        "reporting availability, p99-under-faults, "
+                        "shed/bisect/rollback counts and the "
+                        "recompile count (must stay 0 — bisection "
+                        "reuses existing bucket programs)")
     p.add_argument("--swap-during-load", action="store_true", default=None,
                    help="[serve] add a closed-loop phase with a REAL "
                         "model roll mid-window: load + pre-warm a second "
@@ -208,6 +217,7 @@ def main(argv=None) -> int:
                    "--serve-slo-ms": args.serve_slo_ms,
                    "--no-adaptive": args.no_adaptive,
                    "--baseline": args.baseline,
+                   "--chaos": args.chaos,
                    "--swap-during-load": args.swap_during_load,
                    "--artifact-dir": args.artifact_dir,
                    "--no-artifact": args.no_artifact}
@@ -968,6 +978,189 @@ def _serve_ragged_leg(router, metrics, factory, make_batcher,
     return leg
 
 
+def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
+                     compiles, pipelined: int, duration: float,
+                     qps: float) -> dict:
+    """The resilience proof leg (ISSUE 5 acceptance): a seeded fault
+    schedule driven open-loop against the full resilience stack, with
+    every request's outcome tracked individually.
+
+    Schedule (deterministic — seeded injector + seeded arrivals):
+
+    - **poison requests**: request-sticky dispatch faults on ~1.5% of
+      requests (`batch.dispatch:mode=request`). A poisoned request
+      fails every dispatch containing it, so its cohort only survives
+      if bisection isolates the culprit — the leg checks EXACT
+      isolation: requests failed by dispatch injection == distinct
+      requests the injector poisoned (no cohort-mate was misblamed, no
+      culprit slipped through).
+    - **a forced breaker trip**: after a warm stretch, fetch faults
+      pinned to the live version (`engine.fetch:p=1,version=...`)
+      blast its failure window; the circuit breaker must trip and
+      auto-promote the healthy fallback resident loaded up front —
+      after which the rule no longer matches and traffic recovers
+      inside the same measured window.
+    - **deadline sheds**: a slice of requests carries an unmeetable
+      X-Deadline-Ms-style budget; they must be shed pre-dispatch
+      (counted, zero device work).
+
+    Availability is reported over the non-injected population (the
+    culprits themselves, deadline sheds and watermark rejects are the
+    fault load, not collateral): anything ELSE failing means a
+    resilience path broke its neighbors. The whole leg must also stay
+    recompile-free — bisection sub-segments and the rollback target
+    both reuse programs already on the bucket ladder."""
+    import random
+
+    import numpy as np
+
+    from distributedmnist_tpu.serve import (CircuitBreaker,
+                                            DeadlineExceeded, Rejected,
+                                            ResiliencePolicy, faults)
+    from distributedmnist_tpu.serve.faults import InjectedFault
+    from distributedmnist_tpu.serve.scheduler import fit_dispatch_cost
+
+    live = registry.live_version()
+    fallback = registry.add(factory.init_params(202),
+                            version="v-chaos-fallback",
+                            source="fresh-init")
+    steady_from = compiles.snapshot()    # fallback warmup excluded
+    # A tight breaker so the trip lands well inside the leg: ~1.5s of
+    # outcomes, a dozen requests of volume, half failing.
+    breaker = CircuitBreaker(window_s=1.5, min_requests=12,
+                             failure_ratio=0.5, cooldown_s=60.0)
+    res = ResiliencePolicy(bisect=True, breaker=breaker,
+                           registry=registry, metrics=metrics)
+    # Cohort-sized coalescing: poison isolation is only exercised when
+    # drains hold several requests, so the wait covers ~3 Poisson
+    # inter-arrivals at the driven rate (or the measured full-batch
+    # service time if that is longer — the ragged leg's balance point).
+    overhead_s, per_row_s = fit_dispatch_cost(router.bucket_costs())
+    wait_us = max(int(3e6 / qps), 2000, int(
+        (overhead_s + per_row_s * factory.buckets[-1]) * 1e6))
+    chaos_duration = max(3.0 * duration, 6.0)
+    # The storm: every fetch on the live version fails once 40 batches
+    # have served clean. The breaker must trip and roll back — rollback
+    # is what ENDS the storm (the rule stops matching the new live
+    # version); count=200 is only the backstop against a broken
+    # rollback turning the leg into a total outage.
+    spec = ("batch.dispatch:mode=request,p=0.015;"
+            f"engine.fetch:p=1,count=200,after=40,version={live}")
+    inj = faults.install(faults.FaultInjector.from_spec(spec, seed=23))
+    _mark(f"chaos: schedule {spec!r} (seed 23), {chaos_duration:.0f}s "
+          f"open loop at qps={qps:g}, wait {wait_us}us, fallback "
+          f"{fallback.version} resident")
+
+    rng = np.random.default_rng(13)
+    sizes = [int(s)
+             for s in rng.integers(1, min(8, factory.max_batch) + 1, 256)]
+    reqs = [rng.integers(0, 256, (n, 28, 28, 1), dtype=np.uint8)
+            for n in sizes]
+    batcher = make_batcher(pipelined, adaptive=False, wait_us=wait_us,
+                           resilience=res)
+    outcomes: list = []
+    futures: list = []
+    try:
+        metrics.reset()
+        arrivals = random.Random(3)
+        t_end = time.monotonic() + chaos_duration
+        next_t = time.monotonic()
+        i = 0
+        while next_t < t_end:
+            now = time.monotonic()
+            if next_t > now:
+                time.sleep(next_t - now)
+            deadline = None
+            if i % 25 == 7:
+                # an unmeetable budget: must shed pre-dispatch
+                deadline = time.monotonic() + 5e-4
+            try:
+                futures.append(batcher.submit(reqs[i % len(reqs)],
+                                              deadline_s=deadline))
+            except DeadlineExceeded:
+                outcomes.append("deadline")
+            except Rejected:
+                outcomes.append("rejected")
+            i += 1
+            next_t += arrivals.expovariate(qps)
+        _drain_or_die(batcher, timeout=120)
+        for fut in futures:
+            try:
+                fut.result(timeout=60)
+                outcomes.append("ok")
+            except InjectedFault as e:
+                outcomes.append(f"injected:{e.point}")
+            except DeadlineExceeded:
+                outcomes.append("deadline")
+            except Exception:
+                outcomes.append("other")
+        snap = metrics.snapshot()
+    finally:
+        faults.uninstall()
+        batcher.stop()
+
+    n = len(outcomes)
+    n_ok = outcomes.count("ok")
+    n_poison = outcomes.count("injected:batch.dispatch")
+    n_fetch = outcomes.count("injected:engine.fetch")
+    n_deadline = outcomes.count("deadline")
+    n_rejected = outcomes.count("rejected")
+    n_other = n - n_ok - n_poison - n_fetch - n_deadline - n_rejected
+    denom = max(n_ok + n_other, 1)
+    availability = n_ok / denom
+    poisoned = inj.poisoned()
+    events = registry.events()
+    rollbacks = [e for e in events if e.get("event") == "rollback"]
+    recompiles = compiles.snapshot() - steady_from
+    resil = snap["resilience"]
+    leg = {
+        "spec": spec,
+        "injector_seed": 23,
+        "arrivals_seed": 3,
+        "qps": qps,
+        "duration_s": round(chaos_duration, 3),
+        "coalesce_wait_us": wait_us,
+        "requests": n,
+        "ok": n_ok,
+        # the injected fault load, split by class
+        "injected_dispatch_faults": n_poison,
+        "injected_fetch_faults": n_fetch,
+        "deadline_shed": n_deadline,
+        "rejected": n_rejected,
+        "other_failures": n_other,
+        # ISSUE 5 acceptance: non-injected traffic must stay >= 99%
+        # available, every poison isolated exactly, rollback engaged,
+        # and the whole storm recompile-free
+        "availability_excluding_injected": round(availability, 5),
+        "availability_ok": availability >= 0.99,
+        "p99_under_faults_ms": snap["latency_ms"]["p99"],
+        "poison_unique": len(poisoned),
+        "poison_isolated_exact": n_poison == len(poisoned) > 0,
+        "bisect_splits": resil["bisect_splits"],
+        "bisect_rescued_requests": resil["bisect_rescued_requests"],
+        "deadline_shed_metric": resil["deadline_shed_requests"],
+        "breaker_trips": breaker.trips(),
+        "rollbacks": len(rollbacks),
+        "rollback_events": rollbacks,
+        "rollback_engaged": (len(rollbacks) >= 1
+                             and registry.live_version()
+                             == fallback.version),
+        "live_version_after": registry.live_version(),
+        "fallback_warmup_compile_events": fallback.warmup_compile_events,
+        "recompiles_during_chaos": recompiles,
+    }
+    _mark(f"chaos: {n} requests — {n_ok} ok, {n_poison} poison culprits "
+          f"(unique {len(poisoned)}, exact isolation "
+          f"{leg['poison_isolated_exact']}), {n_fetch} trip victims, "
+          f"{n_deadline} deadline-shed, {n_other} OTHER failures; "
+          f"availability {availability:.4f}; "
+          f"{resil['bisect_rescued_requests']} cohort-mates rescued in "
+          f"{resil['bisect_splits']} splits; breaker trips "
+          f"{breaker.trips()}, rollback -> {leg['live_version_after']}; "
+          f"{recompiles} recompiles")
+    return leg
+
+
 def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
     """The --baseline comparison block: current-vs-prior deltas on the
     stable serve signals (device_kind equality was enforced before any
@@ -1022,11 +1215,37 @@ def _next_serve_artifact(artifact_dir: str) -> str:
     return os.path.join(artifact_dir, f"BENCH_serve_r{n:02d}.json")
 
 
+def _git_provenance() -> dict:
+    """The code identity behind a serve artifact: commit hash plus a
+    dirty flag, so cross-round deltas can be tied to CODE, not just
+    silicon (a record from an uncommitted tree must say so). Best
+    effort: a non-repo checkout or missing git yields Nones, never a
+    failed bench."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    prov = {"git_commit": None, "git_dirty": None}
+    try:
+        r = subprocess.run(["git", "rev-parse", "HEAD"], cwd=root,
+                           capture_output=True, text=True, timeout=10)
+        if r.returncode == 0:
+            prov["git_commit"] = r.stdout.strip()
+            d = subprocess.run(["git", "status", "--porcelain"],
+                               cwd=root, capture_output=True, text=True,
+                               timeout=10)
+            if d.returncode == 0:
+                prov["git_dirty"] = bool(d.stdout.strip())
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return prov
+
+
 def _host_provenance(factory) -> dict:
-    """Host + accelerator identity for the serve artifact: which machine
-    and which silicon produced the number. `device_kind` is the honest
-    chip name ('cpu' on the virtual mesh, 'TPU v4' etc. on real
-    hardware); chip_count restates the normalization denominator."""
+    """Host + accelerator + code identity for the serve artifact: which
+    machine, which silicon, and which commit produced the number.
+    `device_kind` is the honest chip name ('cpu' on the virtual mesh,
+    'TPU v4' etc. on real hardware); chip_count restates the
+    normalization denominator."""
     import platform as platform_mod
     import socket
 
@@ -1038,6 +1257,7 @@ def _host_provenance(factory) -> dict:
         "backend": factory.platform,
         "device_kind": factory.mesh.devices.flat[0].device_kind,
         "chip_count": factory.n_chips,
+        **_git_provenance(),
     }
 
 
@@ -1130,6 +1350,8 @@ def _serve(args) -> int:
                                             build_serving)
     from distributedmnist_tpu.utils import CompileCounter
 
+    from distributedmnist_tpu.serve import build_resilience
+
     cfg = Config(model=args.model, dtype=args.dtype)
     metrics = ServeMetrics()
     # Resolve backend-dependent defaults AFTER the backend is up (the
@@ -1197,9 +1419,18 @@ def _serve(args) -> int:
     rng = np.random.default_rng(0)
     req = rng.integers(0, 256, (rows, 28, 28, 1), dtype=np.uint8)
 
+    # Every bench batcher runs WITH the resilience stack wired (bisect +
+    # breaker + rid/deadline plumbing), exactly as serve.py wires it:
+    # the happy-path headline therefore PRICES the resilience layer —
+    # chaos-off capacity within noise of the pre-ISSUE 5 record is the
+    # no-tax proof, not an unwired best case. The chaos leg swaps in its
+    # own tighter-windowed policy.
+    default_resilience = build_resilience(cfg, registry=registry,
+                                          metrics=metrics)
+
     def make_batcher(max_inflight: int, split: bool = True,
-                     adaptive: bool = None,
-                     wait_us: int = None) -> DynamicBatcher:
+                     adaptive: bool = None, wait_us: int = None,
+                     resilience=None) -> DynamicBatcher:
         if adaptive is None:
             adaptive = not args.no_adaptive
         return DynamicBatcher(router, max_batch=factory.max_batch,
@@ -1209,6 +1440,9 @@ def _serve(args) -> int:
                               max_inflight=max_inflight,
                               slo_ms=args.serve_slo_ms,
                               adaptive=adaptive, split=split,
+                              resilience=(default_resilience
+                                          if resilience is None
+                                          else resilience),
                               metrics=metrics).start()
 
     # Phase 1 — serial baseline: inflight=1 is the pre-pipeline chain
@@ -1311,11 +1545,27 @@ def _serve(args) -> int:
               f"{swap['recompiles_after_swap']} recompiles after swap")
     piped.stop()
 
+    # Phase 5 (optional) — the chaos leg (ISSUE 5 acceptance): seeded
+    # fault schedule against the resilience stack, after the clean
+    # phases so an injected storm can't contaminate the happy-path
+    # numbers. Runs on its own batcher; leaves the fallback version
+    # live when the forced breaker trip rolled back.
+    chaos = None
+    if args.chaos:
+        # 2x the sub-capacity sweep rate: drains must coalesce several
+        # requests for poison isolation to have cohorts to rescue
+        chaos = _serve_chaos_leg(registry, router, factory, metrics,
+                                 make_batcher, compiles, pipelined,
+                                 duration, 2 * low_qps)
+
     recompiles = compiles.snapshot() - steady_from
     if swap is not None:
         # the candidate's warmup compiles are warmup, not steady-state
         # recompiles — same exclusion the boot warmup gets
         recompiles -= swap["warmup_compile_events"]
+    if chaos is not None:
+        # same exclusion for the chaos fallback's off-hot-path warmup
+        recompiles -= chaos["fallback_warmup_compile_events"]
     if recompiles:
         _mark(f"WARNING: {recompiles} compile events after warmup — "
               "steady state was supposed to be shape-stable")
@@ -1360,6 +1610,7 @@ def _serve(args) -> int:
             "qps_sweep": table,
             "ragged": ragged,
             "swap": swap,
+            "chaos": chaos,
             # The measured overlap win (ISSUE 2 acceptance): pipelined
             # capacity over the serial chain, and sub-capacity open-loop
             # latency at both depths — pipelining must buy throughput

@@ -132,6 +132,21 @@ class Config:
     # resident version pins a full param set in device memory — the cap
     # bounds HBM cost; past it the oldest routeless version is evicted.
     serve_max_versions: int = 4
+    # resilience (ISSUE 5, serve/resilience.py + serve/faults.py):
+    # serve_bisect gates poison-batch isolation — a failed multi-request
+    # dispatch is retried as recursively split halves so only the
+    # culprit request fails. The circuit breaker demotes a live version
+    # whose sliding window (serve_breaker_window_s seconds, at least
+    # serve_breaker_min_requests of volume) crosses serve_breaker_ratio
+    # failures, auto-promoting the newest healthy resident.
+    # serve_faults installs a FaultInjector from a spec string
+    # ("point:k=v,...;point2:..." — see serve/faults.py); None (the
+    # default) leaves every woven failpoint inert.
+    serve_bisect: bool = True
+    serve_breaker_window_s: float = 5.0
+    serve_breaker_min_requests: int = 20
+    serve_breaker_ratio: float = 0.5
+    serve_faults: Optional[str] = None
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -251,6 +266,27 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="[serving] warmed model versions kept resident "
                         "in the registry (live + rollback/candidates); "
                         "each pins one param set in device memory")
+    p.add_argument("--no-bisect", dest="serve_bisect",
+                   action="store_false", default=None,
+                   help="[serving] fail a whole batch on a dispatch "
+                        "error instead of bisecting it to isolate the "
+                        "poison request")
+    p.add_argument("--serve-breaker-window-s", type=float, default=None,
+                   help="[serving] circuit-breaker sliding window in "
+                        "seconds over per-version request outcomes")
+    p.add_argument("--serve-breaker-min-requests", type=int, default=None,
+                   help="[serving] minimum window volume before the "
+                        "breaker may trip (no tripping on one bad "
+                        "request at 3am)")
+    p.add_argument("--serve-breaker-ratio", type=float, default=None,
+                   help="[serving] failure ratio within the window that "
+                        "trips the breaker and auto-rolls the live "
+                        "version back (0 < ratio <= 1)")
+    p.add_argument("--serve-faults", default=None, metavar="SPEC",
+                   help="[serving] install a fault-injection schedule "
+                        "(serve/faults.py spec string, e.g. "
+                        "'engine.fetch:p=0.01,latency_ms=5'); chaos "
+                        "testing only — default: all failpoints inert")
     p.add_argument("--no-flat-optimizer", dest="flat_optimizer",
                    action="store_false", default=None,
                    help="per-leaf optimizer update instead of the fused "

@@ -12,9 +12,14 @@ north star's "serves heavy traffic from millions of users".
               staging-vs-fetch split, per-version populations and
               shadow-comparison aggregates, JSON-line records
 - registry.py checkpoint-backed versioned model store: params-only
-              restore, pre-warmed engines, atomic promotion, eviction
+              restore, pre-warmed engines, atomic promotion, eviction,
+              rollback events
 - router.py   version-aware dispatch between batcher and engines:
               hot-swap, shadow duplication, canary splitting
+- faults.py   config-driven fault injection: named failpoints woven
+              through every serving layer, fully inert when disabled
+- resilience.py deadline shedding, poison-batch bisection policy, and
+              the per-version circuit breaker with auto-rollback
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -50,6 +55,19 @@ _EXPORTS = {
     "Router": ("distributedmnist_tpu.serve.router", "Router"),
     "RoutedHandle": ("distributedmnist_tpu.serve.router", "RoutedHandle"),
     "NoLiveModel": ("distributedmnist_tpu.serve.router", "NoLiveModel"),
+    "FaultInjector": ("distributedmnist_tpu.serve.faults",
+                      "FaultInjector"),
+    "FaultRule": ("distributedmnist_tpu.serve.faults", "FaultRule"),
+    "InjectedFault": ("distributedmnist_tpu.serve.faults",
+                      "InjectedFault"),
+    "CircuitBreaker": ("distributedmnist_tpu.serve.resilience",
+                       "CircuitBreaker"),
+    "DeadlineExceeded": ("distributedmnist_tpu.serve.resilience",
+                         "DeadlineExceeded"),
+    "ResiliencePolicy": ("distributedmnist_tpu.serve.resilience",
+                         "ResiliencePolicy"),
+    "build_resilience": ("distributedmnist_tpu.serve.resilience",
+                         "build_resilience"),
 }
 
 __all__ = list(_EXPORTS)

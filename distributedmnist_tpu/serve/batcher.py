@@ -40,10 +40,25 @@ on SLO violations (`slo_ms`) and creeps it back up under headroom, with
 the configured `max_wait_us` as a hard cap and an arrival-rate EWMA
 bounding the wait at the batch fill time (`adaptive=False` pins the
 static wait — serve.py's --no-adaptive).
+
+Resilience (ISSUE 5, serve/resilience.py): requests may carry a
+client-supplied **deadline**; an expired request is shed at pop time —
+before any device work — failing its future with DeadlineExceeded (504
+semantics, the fast path out). A failed multi-request dispatch is
+**bisected**: retried as recursively split sub-segments along request
+boundaries, so a single poison request is isolated (its cohort-mates
+succeed on re-dispatch; only the culprit gets the error). Sub-segments
+cover with buckets already on the ladder — isolation never compiles a
+new shape. Every fan-out's outcome feeds the per-version circuit
+breaker (ResiliencePolicy.record_outcome), whose trip auto-rolls the
+live version back. The dispatch site is a named failpoint
+(`batch.dispatch`, ctx=request ids) so serve/faults.py can inject
+deterministic poison for tests and `bench.py serve --chaos`.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -52,6 +67,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional
 
+from distributedmnist_tpu.serve.faults import failpoint
+from distributedmnist_tpu.serve.resilience import DeadlineExceeded
 from distributedmnist_tpu.serve.scheduler import (AdaptiveController,
                                                   plan_segments)
 
@@ -82,6 +99,9 @@ class _Request:
     x: "object"                   # (n, 28, 28, 1) uint8 ndarray
     n: int
     t_enqueue: float              # time.monotonic()
+    rid: int = 0                  # unique per submit — the identity the
+    #   fault injector's request-sticky draws and bisection key on
+    deadline: Optional[float] = None   # monotonic; None = no deadline
     future: Future = field(default_factory=Future)
 
 
@@ -101,8 +121,14 @@ class DynamicBatcher:
                  queue_depth: int = 4096, metrics=None,
                  max_inflight: Optional[int] = None,
                  slo_ms: Optional[float] = None, adaptive: bool = True,
-                 split: bool = True):
+                 split: bool = True, resilience=None):
         self.engine = engine
+        # ISSUE 5 policy bundle (serve/resilience.py): gates the failed-
+        # dispatch bisection path and receives every fan-out outcome for
+        # the per-version circuit breaker. None = PR 4 behavior (whole
+        # segment fails on a dispatch error, no breaker).
+        self.resilience = resilience
+        self._rid = itertools.count(1)
         self.max_batch = min(max_batch or engine.max_batch,
                              engine.buckets[-1])
         if self.max_batch < 1:
@@ -146,17 +172,31 @@ class DynamicBatcher:
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, x) -> Future:
+    def submit(self, x, deadline_s: Optional[float] = None) -> Future:
         """Enqueue up to max_batch rows; Future resolves to their logits.
-        Raises Rejected past the queue watermark (overload shedding) and
-        ValueError for requests no single dispatch could ever carry."""
+        Raises Rejected past the queue watermark (overload shedding),
+        ValueError for requests no single dispatch could ever carry,
+        and DeadlineExceeded when `deadline_s` (a time.monotonic()
+        deadline, e.g. serve.py's X-Deadline-Ms header) has already
+        passed — an expired request must cost zero queue and device
+        work. A still-live deadline rides the request into the queue;
+        the dispatch thread sheds it at pop time if it expires while
+        waiting (the 504-fast path — see _take_batch)."""
         x = self.engine._as_images(x)
         n = x.shape[0]
         if n > self.max_batch:
             raise ValueError(
                 f"request of {n} rows exceeds max_batch={self.max_batch};"
                 " split it client-side")
-        req = _Request(x=x, n=n, t_enqueue=time.monotonic())
+        now = time.monotonic()
+        if deadline_s is not None and now >= deadline_s:
+            if self.metrics is not None:
+                self.metrics.record_deadline_shed(n)
+            raise DeadlineExceeded(
+                "deadline already expired at submit "
+                f"({(now - deadline_s) * 1e3:.1f} ms ago)")
+        req = _Request(x=x, n=n, t_enqueue=now, rid=next(self._rid),
+                       deadline=deadline_s)
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is stopped")
@@ -253,36 +293,62 @@ class DynamicBatcher:
         and inflight==0" really means drained — the bench's open-loop
         drain predicate, and the reason stop(drain=True) cannot lose a
         popped-but-undispatched segment (the PR 2 drain hole, audited
-        for the split window)."""
+        for the split window).
+
+        Expired-deadline requests (ISSUE 5) are shed HERE, as they are
+        popped: their futures fail with DeadlineExceeded (504-fast)
+        without ever counting toward the dispatch, so a request whose
+        client has already given up costs zero device work — and frees
+        its slice of max_batch for requests still worth serving. A pop
+        that sheds its entire drain loops back to coalescing instead of
+        returning [] (the shutdown signal)."""
         with self._cond:
-            while not self._q and not self._stop:
-                self._cond.wait(0.1)
-            if not self._q:
-                return []
-            # Sample the effective wait when work is actually in hand
-            # (the controller may have moved while the queue was idle).
-            wait_s = (self.controller.effective_wait_s()
-                      if self.controller is not None else self.max_wait_s)
-            if self.metrics is not None:
-                self.metrics.record_wait(wait_s)
-            deadline = self._q[0].t_enqueue + wait_s
-            while self._rows < self.max_batch and not self._stop:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._cond.wait(remaining)
-            batch = []
-            taken = 0
-            while self._q and taken + self._q[0].n <= self.max_batch:
-                req = self._q.popleft()
-                taken += req.n
-                batch.append(req)
-            self._rows -= taken
-            segments = self._plan(batch)
-            if segments:
-                with self._inflight_lock:
-                    self._inflight += len(segments)
-            return segments
+            while True:
+                segments = self._take_batch_locked()
+                if segments is not None:
+                    return segments
+
+    def _take_batch_locked(self) -> Optional[list[list[_Request]]]:
+        """One coalesce-pop-shed-plan cycle under self._cond; None means
+        'everything popped was shed — coalesce again'."""
+        while not self._q and not self._stop:
+            self._cond.wait(0.1)
+        if not self._q:
+            return []
+        # Sample the effective wait when work is actually in hand
+        # (the controller may have moved while the queue was idle).
+        wait_s = (self.controller.effective_wait_s()
+                  if self.controller is not None else self.max_wait_s)
+        if self.metrics is not None:
+            self.metrics.record_wait(wait_s)
+        deadline = self._q[0].t_enqueue + wait_s
+        while self._rows < self.max_batch and not self._stop:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self._cond.wait(remaining)
+        batch = []
+        taken = 0
+        now = time.monotonic()
+        while self._q and taken + self._q[0].n <= self.max_batch:
+            req = self._q.popleft()
+            self._rows -= req.n
+            if req.deadline is not None and now >= req.deadline:
+                if self.metrics is not None:
+                    self.metrics.record_deadline_shed(req.n)
+                req.future.set_exception(DeadlineExceeded(
+                    "deadline expired while queued "
+                    f"({(now - req.deadline) * 1e3:.1f} ms past); "
+                    "shed before dispatch"))
+                continue
+            taken += req.n
+            batch.append(req)
+        if not batch:
+            return None           # whole drain shed: coalesce again
+        segments = self._plan(batch)
+        with self._inflight_lock:
+            self._inflight += len(segments)
+        return segments
 
     def _plan(self, batch: list[_Request]) -> list[list[_Request]]:
         """The batch former: cut one drain into bucket-shaped dispatch
@@ -305,6 +371,25 @@ class DynamicBatcher:
             off += c
         return segments
 
+    def _live_version(self) -> Optional[str]:
+        """The version a dispatch failure is blamed on: the engine's
+        live target (Router) or its own version label (bare engine);
+        None (never breaker-counted) when neither exists — e.g. a
+        NoLiveModel failure while warming has no version to blame."""
+        live_fn = getattr(self.engine, "live_version", None)
+        if callable(live_fn):
+            return live_fn()
+        return getattr(self.engine, "version", None)
+
+    def _engine_dispatch(self, seg: list[_Request]):
+        """The one engine.dispatch call site, crossed by every first
+        dispatch AND every bisection retry: the `batch.dispatch`
+        failpoint fires with the segment's request ids, so a
+        request-sticky injected fault (serve/faults.py) fails every
+        dispatch containing the poison request — and only those."""
+        failpoint("batch.dispatch", rids=[r.rid for r in seg])
+        return self.engine.dispatch([r.x for r in seg])
+
     def _dispatch_loop(self) -> None:
         while True:
             # Acquire the window slot BEFORE coalescing: while the
@@ -326,16 +411,14 @@ class DynamicBatcher:
                     self._slots.acquire()
                 t0 = time.monotonic()
                 try:
-                    handle = self.engine.dispatch([r.x for r in seg])
-                except Exception as e:   # fail the segment, keep serving
-                    # failures fan out BEFORE the segment leaves the
-                    # in-flight count — same drain invariant as the
-                    # completion loop; remaining segments still dispatch
-                    for r in seg:
-                        r.future.set_exception(e)
-                    with self._inflight_lock:
-                        self._inflight -= 1
-                    self._slots.release()
+                    handle = self._engine_dispatch(seg)
+                except Exception as e:   # fail/bisect, keep serving
+                    # the failure path resolves every future in the
+                    # segment (culprit errors, cohort-mate retries)
+                    # BEFORE the segment leaves the in-flight count —
+                    # same drain invariant as the completion loop;
+                    # remaining segments still dispatch
+                    self._dispatch_failed(seg, e)
                     continue
                 with self._inflight_lock:
                     self._dispatched += 1
@@ -344,6 +427,104 @@ class DynamicBatcher:
                     self.metrics.record_dispatch(time.monotonic() - t0,
                                                  inflight=depth)
                 self._handles.put((seg, handle))
+
+    def _dispatch_failed(self, seg: list[_Request], e: Exception) -> None:
+        """A dispatched segment raised before reaching the device queue.
+        Without a resilience policy (or for a single-request segment,
+        where the culprit IS the segment) the whole cohort fails — the
+        PR 1-4 behavior. With bisection enabled, the segment is retried
+        as recursively split halves along request boundaries: a poison
+        request deterministically re-fails every sub-dispatch that
+        contains it, so the recursion bottoms out failing ONLY the
+        culprit's singleton while every cohort-mate's sub-segment
+        dispatches clean. Sub-segments are smaller than the original,
+        so their covering buckets are existing ladder rungs — isolation
+        reuses compiled programs, never new shapes (the chaos bench
+        asserts recompiles stay 0 through a fault storm).
+
+        Accounting: the caller's segment holds one in-flight count and
+        one window slot. The first successfully dispatched sub-segment
+        inherits them; each further one acquires its own slot (the
+        completion thread frees slots as it drains, so this cannot
+        deadlock even at max_inflight=1 — the split-drain argument). If
+        every sub-dispatch fails, the parent's count and slot are
+        released here.
+
+        Dispatch failures feed the circuit breaker too: the routed
+        version is unknown (the exception aborted before a handle
+        existed), so the failure is attributed to the version that
+        WOULD have served it — the live one. An engine that dies at
+        dispatch() must be able to trip the breaker exactly like one
+        that dies at fetch()."""
+        res = self.resilience
+        # 503-shaped errors (NoLiveModel while warming/draining) are
+        # SYSTEMIC sheds, not request faults: splitting the segment
+        # would re-raise identically on every sub-dispatch — O(n)
+        # futile retries whose singleton failures would then masquerade
+        # as "isolated poison" in the telemetry. They also blame no
+        # version (nothing was live to blame).
+        systemic = getattr(e, "status", None) == 503
+        bisect = (res is not None and res.bisect and len(seg) > 1
+                  and not systemic)
+        if not bisect:
+            if self.metrics is not None:
+                if (not systemic and res is not None and res.bisect
+                        and len(seg) == 1):
+                    # a singleton failing at dispatch IS an isolated
+                    # culprit (no cohort to protect)
+                    self.metrics.record_poison_isolated(seg[0].n)
+                else:
+                    self.metrics.record_dispatch_error(len(seg))
+            for r in seg:
+                r.future.set_exception(e)
+            if res is not None and not systemic:
+                res.record_outcome(self._live_version(), ok=False,
+                                   n=len(seg))
+            with self._inflight_lock:
+                self._inflight -= 1
+            self._slots.release()
+            return
+        if self.metrics is not None:
+            self.metrics.record_bisect_split()
+        mid = len(seg) // 2
+        pending: deque = deque([seg[:mid], seg[mid:]])
+        enqueued = 0
+        while pending:
+            sub = pending.popleft()
+            try:
+                handle = self._engine_dispatch(sub)
+            except Exception as se:
+                if len(sub) == 1:
+                    if self.metrics is not None:
+                        self.metrics.record_poison_isolated(sub[0].n)
+                    sub[0].future.set_exception(se)
+                    if res is not None:
+                        res.record_outcome(self._live_version(),
+                                           ok=False)
+                else:
+                    if self.metrics is not None:
+                        self.metrics.record_bisect_split()
+                    m = len(sub) // 2
+                    # left half first: FIFO order is preserved across
+                    # the completion thread's in-order fetches
+                    pending.appendleft(sub[m:])
+                    pending.appendleft(sub[:m])
+                continue
+            if enqueued:
+                self._slots.acquire()
+                with self._inflight_lock:
+                    self._inflight += 1
+            with self._inflight_lock:
+                self._dispatched += 1
+            if self.metrics is not None:
+                self.metrics.record_bisect_rescued(
+                    len(sub), sum(r.n for r in sub))
+            self._handles.put((sub, handle))
+            enqueued += 1
+        if not enqueued:
+            with self._inflight_lock:
+                self._inflight -= 1
+            self._slots.release()
 
     def _completion_loop(self) -> None:
         while True:
@@ -357,6 +538,15 @@ class DynamicBatcher:
             except Exception as e:   # fan the failure out, keep serving
                 for r in batch:
                     r.future.set_exception(e)
+                if self.metrics is not None:
+                    self.metrics.record_fetch_error(len(batch))
+                if self.resilience is not None:
+                    # a fetch failure is attributable: the handle knows
+                    # which version computed (and failed) the batch —
+                    # the circuit breaker's per-version failure signal
+                    self.resilience.record_outcome(
+                        getattr(handle, "version", None), ok=False,
+                        n=len(batch))
                 with self._inflight_lock:
                     self._inflight -= 1
                     self._dispatched -= 1
@@ -364,6 +554,9 @@ class DynamicBatcher:
                 continue
             t_done = time.monotonic()
             version = getattr(handle, "version", None)
+            if self.resilience is not None:
+                self.resilience.record_outcome(version, ok=True,
+                                               n=len(batch))
             if self.controller is not None:
                 # Feed the AIMD controller every request's end-to-end
                 # latency — violations step the effective wait down

@@ -30,6 +30,7 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.utils import (CompileCounter,
                                         enable_compilation_cache, round_up)
 
@@ -197,6 +198,11 @@ class InferenceEngine:
                  else [self._as_images(x)])
         n = sum(p.shape[0] for p in parts)
         b = self.bucket_for(n)
+        # Fault-injection seam (serve/faults.py; inert when no injector
+        # is installed). Fired BEFORE the staging take so an injected
+        # dispatch error never strands a pooled buffer.
+        failpoint("engine.dispatch", version=self.version, rows=n,
+                  bucket=b)
         staging = self._staging_take(b)
         off = 0
         for p in parts:
@@ -216,11 +222,23 @@ class InferenceEngine:
         real rows. Recycles the handle's staging buffer; one-shot."""
         if handle.staging is None:
             raise RuntimeError("handle already fetched")
-        out = np.asarray(handle.logits)[:handle.n]
-        with self._staging_lock:
-            self._staging_pool[handle.bucket].append(handle.staging)
-        handle.staging = None
-        return out
+        # The staging buffer is recycled whether the fetch succeeds or
+        # fails (injected fault or real device error): by the time the
+        # value fetch returns OR raises, this batch's execution is
+        # over, so reuse cannot race the device — and a sustained
+        # fetch-failure storm (exactly what the circuit breaker exists
+        # for) must not bleed one pool buffer per failed batch.
+        try:
+            # Fault-injection seam: an injected fetch error is
+            # attributable to THIS handle's version — the chaos
+            # schedule that forces a breaker trip keys on it.
+            failpoint("engine.fetch", version=handle.version,
+                      rows=handle.n)
+            return np.asarray(handle.logits)[:handle.n]
+        finally:
+            with self._staging_lock:
+                self._staging_pool[handle.bucket].append(handle.staging)
+            handle.staging = None
 
     def infer(self, x) -> np.ndarray:
         """Logits (n, 10) for n uint8 images; pad-and-slice through the

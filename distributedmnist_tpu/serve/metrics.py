@@ -71,6 +71,23 @@ class ServeMetrics:
             self._shadow: dict[str, dict] = {}   # "live->shadow" pairs
             self._shadow_errors = 0
             self._shadow_dropped = 0
+            # resilience accounting (ISSUE 5): the unhappy path must be
+            # as observable as the happy one — deadline sheds (504s that
+            # never cost device work), bisection activity (splits,
+            # isolated culprits, rescued cohort-mates), raw error
+            # fan-outs, and breaker trips / auto-rollbacks.
+            self._deadline_shed_requests = 0
+            self._deadline_shed_rows = 0
+            self._bisect_splits = 0
+            self._poison_isolated_requests = 0
+            self._poison_isolated_rows = 0
+            self._bisect_rescued_requests = 0
+            self._bisect_rescued_rows = 0
+            self._dispatch_error_requests = 0
+            self._fetch_error_requests = 0
+            self._breaker_trips = 0
+            self._rollbacks = 0
+            self._last_rollback = None       # {"from", "to", "at"}
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -164,6 +181,59 @@ class ServeMetrics:
         with self._lock:
             self._shadow_dropped += 1
 
+    # -- resilience hooks (ISSUE 5) ----------------------------------------
+
+    def record_deadline_shed(self, rows: int = 1) -> None:
+        """One request shed because its client deadline expired before
+        dispatch (504-fast; zero device work spent)."""
+        with self._lock:
+            self._deadline_shed_requests += 1
+            self._deadline_shed_rows += rows
+
+    def record_bisect_split(self) -> None:
+        """One failed segment split into halves for retry."""
+        with self._lock:
+            self._bisect_splits += 1
+
+    def record_poison_isolated(self, rows: int = 1) -> None:
+        """One culprit request isolated down to its singleton dispatch
+        and failed alone (its cohort-mates were rescued)."""
+        with self._lock:
+            self._poison_isolated_requests += 1
+            self._poison_isolated_rows += rows
+
+    def record_bisect_rescued(self, requests: int, rows: int) -> None:
+        """One sub-segment of a bisected batch dispatched clean: these
+        requests would have failed with their cohort pre-ISSUE 5."""
+        with self._lock:
+            self._bisect_rescued_requests += requests
+            self._bisect_rescued_rows += rows
+
+    def record_dispatch_error(self, requests: int) -> None:
+        """A whole segment failed at dispatch WITHOUT isolation (no
+        resilience policy, or bisection disabled)."""
+        with self._lock:
+            self._dispatch_error_requests += requests
+
+    def record_fetch_error(self, requests: int) -> None:
+        """A dispatched batch's fetch failed; its cohort fanned out the
+        error (the circuit breaker's raw signal)."""
+        with self._lock:
+            self._fetch_error_requests += requests
+
+    def record_breaker_trip(self, version: str) -> None:
+        with self._lock:
+            self._breaker_trips += 1
+
+    def record_rollback(self, from_version: str, to_version: str) -> None:
+        """The breaker's trip demoted `from_version` and auto-promoted
+        `to_version` (the newest healthy registry resident)."""
+        with self._lock:
+            self._rollbacks += 1
+            self._last_rollback = {"from": from_version,
+                                   "to": to_version,
+                                   "at": round(time.time(), 3)}
+
     # -- reporting ---------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -241,6 +311,23 @@ class ServeMetrics:
                     for pair, s in sorted(self._shadow.items())},
                 "shadow_errors": self._shadow_errors,
                 "shadow_dropped": self._shadow_dropped,
+                "resilience": {
+                    "deadline_shed_requests": self._deadline_shed_requests,
+                    "deadline_shed_rows": self._deadline_shed_rows,
+                    "bisect_splits": self._bisect_splits,
+                    "poison_isolated_requests":
+                        self._poison_isolated_requests,
+                    "poison_isolated_rows": self._poison_isolated_rows,
+                    "bisect_rescued_requests":
+                        self._bisect_rescued_requests,
+                    "bisect_rescued_rows": self._bisect_rescued_rows,
+                    "dispatch_error_requests":
+                        self._dispatch_error_requests,
+                    "fetch_error_requests": self._fetch_error_requests,
+                    "breaker_trips": self._breaker_trips,
+                    "rollbacks": self._rollbacks,
+                    "last_rollback": self._last_rollback,
+                },
             }
 
     def record(self) -> dict:

@@ -35,11 +35,13 @@ import dataclasses
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
 from distributedmnist_tpu.serve.engine import InferenceEngine, make_buckets
+from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.serve.router import Router
 
 log = logging.getLogger("distributedmnist_tpu")
@@ -60,6 +62,18 @@ class ModelVersion:
     warmup_compile_events: int = 0
     warmup_s: float = 0.0
     loaded_at: float = 0.0         # time.time()
+    # The last failure this version suffered (restore/warmup exception
+    # string, or the circuit-breaker trip reason that demoted it) plus
+    # its wall-clock timestamp — surfaced in GET /models so an operator
+    # sees WHY a version is failed/rolled-back instead of grepping logs
+    # (ISSUE 5 satellite). None = healthy; auto-rollback only promotes
+    # residents with last_error None.
+    last_error: Optional[str] = None
+    last_error_at: Optional[float] = None
+
+    def record_error(self, error: str) -> None:
+        self.last_error = error
+        self.last_error_at = time.time()
 
     def describe(self) -> dict:
         return {
@@ -70,6 +84,9 @@ class ModelVersion:
             "warmup_compile_events": self.warmup_compile_events,
             "warmup_s": round(self.warmup_s, 3),
             "loaded_at": round(self.loaded_at, 3),
+            "last_error": self.last_error,
+            "last_error_at": (round(self.last_error_at, 3)
+                              if self.last_error_at is not None else None),
             # The warmup-measured per-bucket dispatch cost this
             # version's batch former plans with (GET /models shows an
             # operator what the scheduler believes about each program).
@@ -168,6 +185,10 @@ class ModelRegistry:
         self._state = threading.Lock()
         self._compiles = CompileCounter.instance()
         self._auto_id = 0
+        # Lifecycle events an operator must be able to reconstruct
+        # AFTER the fact (ISSUE 5): circuit-breaker rollbacks above all.
+        # Bounded; surfaced by events(), describe() and /healthz.
+        self._events: deque = deque(maxlen=64)
 
     # -- loading -----------------------------------------------------------
 
@@ -212,6 +233,10 @@ class ModelRegistry:
             # lock still serializes concurrent loads.
             try:
                 t0 = time.perf_counter()
+                # Fault-injection seam (serve/faults.py): an injected
+                # warmup failure exercises the same failed-version
+                # bookkeeping a real compile/OOM failure would.
+                failpoint("registry.warmup", version=version)
                 engine = self.factory.make_engine(params, version)
                 mv.warmup_compile_events = engine.warmup()
                 # Clockwork bar: prove EVERY bucket is compiled by
@@ -226,9 +251,13 @@ class ModelRegistry:
                 mv.engine = engine
                 mv.warmup_s = time.perf_counter() - t0
                 mv.state = "ready"
-            except Exception:
+            except Exception as e:
                 mv.state = "failed"
                 mv.engine = None     # don't pin a half-warm engine's HBM
+                # Surfaced per-version in GET /models, not just logged:
+                # a failed load's WHY must outlive the admin request
+                # that triggered it (ISSUE 5 satellite).
+                mv.record_error(f"warmup: {type(e).__name__}: {e}")
                 raise
             with self._state:
                 self._evict_locked(protect={version})
@@ -283,8 +312,29 @@ class ModelRegistry:
             # Pin the step decided above: a checkpoint committing
             # between the listing and the restore must not smuggle
             # newer params in under the older step's version name.
-            params, step = restore_latest_params(
-                directory, self.factory.abstract_params(), step=step)
+            try:
+                # Fault-injection seam (serve/faults.py): an injected
+                # restore failure drives the same failed-version path a
+                # corrupt/mismatched checkpoint would.
+                failpoint("registry.restore", directory=directory,
+                          step=step)
+                params, step = restore_latest_params(
+                    directory, self.factory.abstract_params(), step=step)
+            except Exception as e:
+                # The restore died before add() could own the version:
+                # register a failed entry anyway so GET /models surfaces
+                # WHAT failed and WHY, instead of the error living only
+                # in one admin response / log line (ISSUE 5 satellite).
+                # A later retry of the same name is allowed (the
+                # failed-entry check above deletes it).
+                mv = ModelVersion(version=version, engine=None,
+                                  state="failed",
+                                  source=f"checkpoint {directory}",
+                                  step=step, loaded_at=time.time())
+                mv.record_error(f"restore: {type(e).__name__}: {e}")
+                with self._state:
+                    self._versions.setdefault(version, mv)
+                raise
             return self.add(params, version=version,
                             source=f"checkpoint {directory}", step=step)
 
@@ -335,6 +385,64 @@ class ModelRegistry:
                     old.state = "ready"
             self._evict_locked(protect={version})
             return mv
+
+    def rollback(self, from_version: str, reason: str
+                 ) -> Optional[ModelVersion]:
+        """Demote `from_version` (if still live) and promote the newest
+        HEALTHY resident — warmed ('ready'), engine resident, no
+        recorded error — emitting a rollback event. The circuit
+        breaker's trip path (serve/resilience.py), callable by an
+        operator too. The demoted version stays resident but gets
+        `reason` as its last_error, which excludes it from being
+        auto-promoted right back (a flapping rollback would be worse
+        than none). Returns the newly live ModelVersion; None when
+        `from_version` is no longer live (someone already rolled) or no
+        healthy fallback exists (the event records that too — serving
+        then keeps limping on the tripped version, which still beats an
+        empty routing table's hard 503)."""
+        with self._admin, self._state:
+            live = self.router.live_version()
+            if live != from_version:
+                log.info("rollback from %s skipped: live is already %s",
+                         from_version, live)
+                return None
+            candidates = [
+                mv for name, mv in self._versions.items()
+                if name != from_version and mv.state == "ready"
+                and mv.engine is not None and mv.last_error is None]
+            now = time.time()
+            old = self._versions.get(from_version)
+            if not candidates:
+                self._events.append({
+                    "event": "rollback_failed", "from": from_version,
+                    "to": None, "reason": reason, "at": round(now, 3)})
+                log.error(
+                    "rollback from %s FAILED: no healthy resident "
+                    "fallback (%s); keeping the tripped version live",
+                    from_version, reason)
+                return None
+            target = max(candidates, key=lambda mv: mv.loaded_at)
+            # promote()'s core, inlined: _state is a plain Lock (not
+            # re-entrant) and the demotion must also stamp last_error
+            # atomically with the swap.
+            self.router.set_live(target.engine, target.version)
+            target.state = "live"
+            if old is not None:
+                old.state = "ready"
+                old.record_error(reason)
+            self._events.append({
+                "event": "rollback", "from": from_version,
+                "to": target.version, "reason": reason,
+                "at": round(now, 3)})
+            log.warning("rollback: %s -> %s (%s)", from_version,
+                        target.version, reason)
+            return target
+
+    def events(self) -> list:
+        """Lifecycle events, oldest first (bounded window): rollbacks
+        and rollback failures — what /healthz and GET /models surface."""
+        with self._state:
+            return list(self._events)
 
     def set_shadow(self, version: str, fraction: float = 0.1
                    ) -> ModelVersion:
@@ -391,6 +499,7 @@ class ModelRegistry:
                 "versions": [mv.describe()
                              for mv in self._versions.values()],
                 "routes": self.router.routes(),
+                "events": list(self._events),
                 "max_versions": self.max_versions,
                 "checkpoint_dir": self.checkpoint_dir,
                 "buckets": list(self.factory.buckets),

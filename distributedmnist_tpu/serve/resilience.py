@@ -1,0 +1,207 @@
+"""Serving resilience policies (ISSUE 5): deadline shedding, failed-
+batch bisection support, and the per-version circuit breaker with
+auto-rollback.
+
+After PRs 1-4 the serving stack had exactly one failure behavior:
+queue-watermark 503. Clipper treats bounded-latency degradation as a
+first-class contract and Clockwork shows predictability requires
+handling the UNHAPPY path as deliberately as the happy one; this module
+is the policy half of that (serve/faults.py is the harness that proves
+it works). Three policies, all pure decision logic — the batcher stays
+the single owner of dispatch mechanics, the registry of version state:
+
+- **Deadline propagation**: a client-supplied budget (the X-Deadline-Ms
+  HTTP header in serve.py) rides each request into the batcher, which
+  sheds expired requests at pop time — BEFORE dispatch — failing their
+  futures with DeadlineExceeded (504 semantics). A request whose
+  deadline already passed must cost zero device work and return fast;
+  computing logits nobody is waiting for is pure capacity theft under
+  load (the Clipper argument, extended from admission to dispatch).
+
+- **Poison-batch bisection** (mechanics live in the batcher's dispatch
+  loop, switched by ResiliencePolicy.bisect): a failed multi-request
+  dispatch is retried by recursively splitting it along request
+  boundaries — cohort-mates succeed on the re-dispatch, only the
+  culprit request keeps failing and gets the 500. Splits land on
+  existing bucket rungs (a sub-segment's covering bucket is always on
+  the ladder), so isolation never compiles a new shape.
+
+- **CircuitBreaker + auto-rollback**: a sliding-window failure-ratio
+  tracker per engine version. When the live version's window trips,
+  ResiliencePolicy demotes it and promotes the newest healthy resident
+  from the ModelRegistry (the PR 3 rollback path, now closed-loop),
+  emitting a rollback event — a bad promote heals in one breaker
+  window instead of waiting for a human on the admin API.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+log = logging.getLogger("distributedmnist_tpu")
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's client-supplied deadline passed before its batch
+    dispatched: shed with 504 semantics (serve.py maps it, with a
+    Retry-After derived from the current pipeline state)."""
+
+    status = 504
+
+
+class CircuitBreaker:
+    """Sliding-window failure-ratio breaker, one window per version.
+
+    record(version, ok) feeds every request outcome; it returns True
+    exactly when THIS record tripped the breaker for that version —
+    failures/window >= failure_ratio with at least min_requests of
+    volume inside window_s. A tripped version enters a cooldown during
+    which it cannot re-trip (the rollback it triggered needs time to
+    take effect; re-tripping on the tail of in-flight failures would
+    flap). Thread-safe: outcomes arrive from the completion thread,
+    snapshots from HTTP threads.
+    """
+
+    def __init__(self, window_s: float = 5.0, min_requests: int = 20,
+                 failure_ratio: float = 0.5, cooldown_s: float = 30.0):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        if min_requests < 1:
+            raise ValueError(
+                f"min_requests must be >= 1, got {min_requests}")
+        if not 0.0 < failure_ratio <= 1.0:
+            raise ValueError(
+                f"failure_ratio must be in (0, 1], got {failure_ratio}")
+        if cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {cooldown_s}")
+        self.window_s = window_s
+        self.min_requests = min_requests
+        self.failure_ratio = failure_ratio
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        # version -> deque[(t, ok, n)] — n-weighted so one failed batch
+        # of k requests carries its real volume
+        self._windows: dict[str, deque] = {}
+        self._cooldown_until: dict[str, float] = {}
+        self._trips = 0
+
+    def record(self, version: str, ok: bool, n: int = 1,
+               now: Optional[float] = None) -> bool:
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            win = self._windows.setdefault(version, deque())
+            win.append((now, ok, n))
+            cutoff = now - self.window_s
+            while win and win[0][0] < cutoff:
+                win.popleft()
+            if now < self._cooldown_until.get(version, 0.0):
+                return False
+            total = sum(w[2] for w in win)
+            if total < self.min_requests:
+                return False
+            failures = sum(w[2] for w in win if not w[1])
+            if failures / total < self.failure_ratio:
+                return False
+            # Trip: start the cooldown and clear the window so the
+            # in-flight failure tail doesn't immediately re-accumulate.
+            self._trips += 1
+            self._cooldown_until[version] = now + self.cooldown_s
+            win.clear()
+            return True
+
+    def trips(self) -> int:
+        with self._lock:
+            return self._trips
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            return {
+                "window_s": self.window_s,
+                "min_requests": self.min_requests,
+                "failure_ratio": self.failure_ratio,
+                "trips": self._trips,
+                "by_version": {
+                    v: {"volume": sum(w[2] for w in win),
+                        "failures": sum(w[2] for w in win if not w[1]),
+                        "cooldown_remaining_s": round(max(
+                            self._cooldown_until.get(v, 0.0) - now,
+                            0.0), 3)}
+                    for v, win in self._windows.items()},
+            }
+
+
+class ResiliencePolicy:
+    """The batcher/server-facing bundle of the three policies.
+
+    The batcher calls exactly two things: `bisect` (a bool gating the
+    dispatch-failure bisection path) and `record_outcome(version, ok,
+    n)` at every batch fan-out. A breaker trip on the LIVE version
+    demotes it and promotes the newest healthy registry resident on a
+    dedicated daemon thread — never the completion thread, which must
+    keep fanning out results while the roll happens (the registry's
+    admin lock may be held by a slow warmup, and rollback must not
+    stall live fan-out behind it).
+    """
+
+    def __init__(self, bisect: bool = True,
+                 breaker: Optional[CircuitBreaker] = None,
+                 registry=None, metrics=None):
+        self.bisect = bisect
+        self.breaker = breaker
+        self.registry = registry
+        self.metrics = metrics
+
+    def record_outcome(self, version: Optional[str], ok: bool,
+                       n: int = 1) -> None:
+        """One batch's fan-out result (version-tagged). Feeds the
+        breaker; a trip triggers the async rollback."""
+        if self.breaker is None or version is None:
+            return
+        if self.breaker.record(version, ok, n=n):
+            self._tripped(version)
+
+    def _tripped(self, version: str) -> None:
+        log.warning("circuit breaker TRIPPED for version %s", version)
+        if self.metrics is not None:
+            self.metrics.record_breaker_trip(version)
+        if self.registry is None:
+            return
+        threading.Thread(target=self._rollback, args=(version,),
+                         name="serve-rollback", daemon=True).start()
+
+    def _rollback(self, version: str) -> None:
+        try:
+            target = self.registry.rollback(
+                version, reason=f"circuit breaker tripped on {version}")
+        except Exception:
+            log.exception("auto-rollback from %s failed", version)
+            return
+        if target is not None:
+            if self.metrics is not None:
+                self.metrics.record_rollback(version, target.version)
+            log.warning("auto-rollback: %s -> %s", version,
+                        target.version)
+
+    def snapshot(self) -> dict:
+        return {
+            "bisect": self.bisect,
+            "breaker": (self.breaker.snapshot()
+                        if self.breaker is not None else None),
+        }
+
+
+def build_resilience(cfg, registry=None, metrics=None) -> ResiliencePolicy:
+    """ResiliencePolicy from Config knobs — the wiring serve.py and the
+    bench share (one construction, no drift in defaults)."""
+    breaker = CircuitBreaker(
+        window_s=cfg.serve_breaker_window_s,
+        min_requests=cfg.serve_breaker_min_requests,
+        failure_ratio=cfg.serve_breaker_ratio)
+    return ResiliencePolicy(bisect=cfg.serve_bisect, breaker=breaker,
+                            registry=registry, metrics=metrics)

@@ -46,6 +46,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from distributedmnist_tpu.serve.engine import InferenceEngine
+from distributedmnist_tpu.serve.faults import failpoint
 
 log = logging.getLogger("distributedmnist_tpu")
 
@@ -259,6 +260,11 @@ class Router:
                     self.metrics.record_shadow_drop()
             else:
                 try:
+                    # Fault-injection seam for the candidate fan-out
+                    # (serve/faults.py): an injected shadow fault must
+                    # be swallowed+counted exactly like a real broken
+                    # candidate — live traffic never pays.
+                    failpoint("router.shadow", version=shadow.version)
                     rh.shadow_handle = shadow.engine.dispatch(x)
                     rh.shadow_engine = shadow.engine
                     rh.shadow_version = shadow.version

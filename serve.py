@@ -17,7 +17,15 @@ Two modes:
                          {"classes": [...], "n": n, "version": ...}
                          503 + Retry-After when the queue is past its
                          backpressure watermark OR no warmed model is
-                         live yet (shed, don't melt)
+                         live yet (shed, don't melt); the optional
+                         X-Deadline-Ms header is the client's latency
+                         budget — a request whose deadline expires
+                         before dispatch is shed with a fast 504
+                         (zero device work) instead of computing an
+                         answer nobody is waiting for. Retry-After on
+                         every shed is derived from the live pipeline
+                         (effective coalescing wait + in-flight depth
+                         at the measured batch service time)
     GET  /metrics        current ServeMetrics snapshot (JSON), incl.
                          per-version populations + shadow comparisons
     GET  /healthz        real state: {"ok", "state":
@@ -58,6 +66,15 @@ table is absent).
 --request-timeout bounds how long an HTTP client thread may wait on its
 future before a 504 — a wedged dispatch pipeline must shed its waiters,
 not hold ThreadingHTTPServer threads forever.
+
+Resilience (ISSUE 5, serve/resilience.py): a failed multi-request
+dispatch is bisected so only the poison request 500s (--no-bisect
+restores whole-cohort failure); every request outcome feeds a
+per-version circuit breaker (--serve-breaker-*) whose trip demotes the
+live version and auto-promotes the newest healthy resident, emitting a
+rollback event visible in /healthz and GET /models. --serve-faults
+installs a deterministic fault-injection schedule (serve/faults.py) for
+chaos drills; without it every woven failpoint is inert.
 """
 
 from __future__ import annotations
@@ -108,6 +125,20 @@ class ServerState:
 
     def healthz(self, registry, batcher) -> tuple[int, dict]:
         live = registry.live_version()
+        # Circuit-breaker rollbacks (ISSUE 5) are surfaced here, not
+        # just logged: a load balancer's health poll is often the first
+        # thing an operator looks at after an availability dip, and
+        # "the breaker auto-rolled v7 back to v6 at 14:02" is the story.
+        events = (registry.events() if hasattr(registry, "events")
+                  else [])
+        # `rollbacks` counts COMPLETED rollbacks only (must agree with
+        # metrics.resilience.rollbacks); last_rollback shows the most
+        # recent attempt of either kind — a FAILED rollback (no healthy
+        # fallback) is exactly what an operator must see, and its
+        # "event": "rollback_failed" / "to": null disambiguate it.
+        attempts = [e for e in events
+                    if e.get("event", "").startswith("rollback")]
+        rollbacks = [e for e in attempts if e.get("event") == "rollback"]
         # Recovery is observable, not sticky: a warmed model going live
         # through ANY path (initial warm thread, admin load+promote,
         # SIGHUP) flips warming/failed -> running — an operator who
@@ -127,6 +158,8 @@ class ServerState:
             "pending_rows": batcher.pending_rows(),
             "inflight_batches": batcher.inflight_batches(),
             "versions": len(registry.describe()["versions"]),
+            "rollbacks": len(rollbacks),
+            "last_rollback": attempts[-1] if attempts else None,
         }
         return (200 if ok else 503), payload
 
@@ -158,11 +191,32 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 metrics_every: float, request_timeout: float,
                 warm) -> dict:
     import concurrent.futures
+    import math
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-    from distributedmnist_tpu.serve import NoLiveModel, Rejected
+    from distributedmnist_tpu.serve import (DeadlineExceeded, NoLiveModel,
+                                            Rejected)
 
     max_body = registry.factory.max_batch * IMAGE_BYTES
+
+    def retry_after() -> dict:
+        """The Retry-After header for every shed response (watermark
+        503, no-live-model 503, deadline 504), derived from live
+        pipeline state instead of a hardcoded guess: the current
+        effective coalescing wait (where the adaptive controller
+        actually sits, not the configured cap) plus the in-flight depth
+        priced at the measured full-batch service time — roughly when
+        the pipeline will have worked off what it already holds. Floors
+        at 1s (the header is integer seconds)."""
+        wait_s = (batcher.controller.effective_wait_s()
+                  if batcher.controller is not None
+                  else batcher.max_wait_s)
+        costs_fn = getattr(batcher.engine, "bucket_costs", None)
+        costs = costs_fn() if callable(costs_fn) else {}
+        svc_s = max(costs.values()) if costs else 0.0
+        depth = batcher.inflight_batches()
+        return {"Retry-After": str(max(1, math.ceil(
+            wait_s + (depth + 1) * svc_s)))}
     # Serializes admin mutations from HTTP/SIGHUP threads so two
     # concurrent loads can't interleave their registry side effects
     # mid-request (the registry's own lock already protects state; this
@@ -216,6 +270,13 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 payload["adaptive"] = (
                     batcher.controller.snapshot()
                     if batcher.controller is not None else None)
+                # the breaker's live windows (per-version volume /
+                # failures / cooldown) — the resilience counters in the
+                # snapshot say what already happened, this says what
+                # the breaker currently believes
+                payload["resilience_policy"] = (
+                    batcher.resilience.snapshot()
+                    if batcher.resilience is not None else None)
                 self._send(200, payload)
             elif self.path == "/models":
                 self._send(200, registry.describe())
@@ -318,6 +379,29 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                                           "images per request"})
                 return
             import numpy as np
+            # Deadline propagation (ISSUE 5): X-Deadline-Ms is the
+            # client's remaining latency budget. It rides the request
+            # into the batcher, which sheds it BEFORE dispatch if it
+            # expires while queued (504 fast, zero device work) — and
+            # bounds this handler's own wait, so the client never
+            # learns its answer later than it said it could use it.
+            hdr = self.headers.get("X-Deadline-Ms")
+            budget_s = deadline_s = None
+            if hdr is not None:
+                try:
+                    budget_s = float(hdr) / 1e3
+                except ValueError:
+                    self._send(400, {"error": "X-Deadline-Ms must be a "
+                                              f"number, got {hdr!r}"})
+                    return
+                if not math.isfinite(budget_s) or budget_s <= 0:
+                    # nan would sail through a bare <= 0 check and
+                    # silently disable the deadline — malformed budgets
+                    # fail loudly like every other malformed input
+                    self._send(400, {"error": "X-Deadline-Ms must be a "
+                                              "finite number > 0"})
+                    return
+                deadline_s = time.monotonic() + budget_s
             raw = self.rfile.read(length)
             x = np.frombuffer(raw, np.uint8).reshape(-1, IMAGE_BYTES)
             try:
@@ -325,22 +409,36 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # handler thread must come back (504) rather than be
                 # held forever — ThreadingHTTPServer has no thread cap,
                 # so unbounded waiters pile up until exhaustion.
-                fut = batcher.submit(x)
-                logits = fut.result(timeout=request_timeout)
+                fut = batcher.submit(x, deadline_s=deadline_s)
+                logits = fut.result(timeout=(
+                    request_timeout if budget_s is None
+                    else min(request_timeout, budget_s)))
             except Rejected:
                 self._send(503, {"error": "overloaded; retry"},
-                           extra={"Retry-After": "1"})
+                           extra=retry_after())
                 return
             except NoLiveModel:
                 # still warming (or drained of versions): same shed
                 # semantics as overload — the client should retry, and
                 # /healthz says why
                 self._send(503, {"error": "no warmed model is live yet"},
-                           extra={"Retry-After": "1"})
+                           extra=retry_after())
+                return
+            except DeadlineExceeded as e:
+                # shed before dispatch: the batcher spent zero device
+                # work on this request (or refused it at submit)
+                self._send(504, {"error": str(e)}, extra=retry_after())
                 return
             except concurrent.futures.TimeoutError:
-                self._send(504, {"error": "inference timed out after "
-                                          f"{request_timeout:g}s"})
+                if (deadline_s is not None
+                        and time.monotonic() >= deadline_s):
+                    self._send(504, {"error": "deadline expired while "
+                                              "awaiting inference"},
+                               extra=retry_after())
+                else:
+                    self._send(504,
+                               {"error": "inference timed out after "
+                                         f"{request_timeout:g}s"})
                 return
             except Exception as e:   # engine fan-out / batcher stopped:
                 # an HTTP error beats a dropped keep-alive connection
@@ -453,19 +551,48 @@ def main(argv=None) -> int:
         p.error("--serve-max-versions must be >= 2 (live + a candidate)")
     if args.serve_slo_ms is not None and args.serve_slo_ms <= 0:
         p.error("--serve-slo-ms must be > 0")
+    if (args.serve_breaker_window_s is not None
+            and args.serve_breaker_window_s <= 0):
+        p.error("--serve-breaker-window-s must be > 0")
+    if (args.serve_breaker_min_requests is not None
+            and args.serve_breaker_min_requests < 1):
+        p.error("--serve-breaker-min-requests must be >= 1")
+    if (args.serve_breaker_ratio is not None
+            and not 0 < args.serve_breaker_ratio <= 1):
+        p.error("--serve-breaker-ratio must be in (0, 1]")
+    if args.serve_faults is not None:
+        # a malformed chaos schedule is a usage error NOW — it must
+        # never boot a server that silently injects nothing
+        from distributedmnist_tpu.serve.faults import parse_spec
+        try:
+            parse_spec(args.serve_faults)
+        except ValueError as e:
+            p.error(f"--serve-faults: {e}")
     cfg = config_lib.from_args(args)
 
     from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
-                                            build_serving)
+                                            build_resilience,
+                                            build_serving, faults)
 
     metrics = ServeMetrics()
     registry, router, factory = build_serving(cfg, metrics=metrics)
+    # The resilience policy bundle (ISSUE 5): deadline shedding and
+    # bisection live in the batcher; the circuit breaker auto-rolls the
+    # live version back through the registry on trip.
+    resilience = build_resilience(cfg, registry=registry, metrics=metrics)
+    if cfg.serve_faults:
+        faults.install(faults.FaultInjector.from_spec(cfg.serve_faults,
+                                                      seed=cfg.seed))
+        log.warning("FAULT INJECTION ACTIVE (--serve-faults %r, seed "
+                    "%d) — this process is a chaos target, not a "
+                    "production server", cfg.serve_faults, cfg.seed)
     batcher = DynamicBatcher(router, max_batch=cfg.serve_max_batch,
                              max_wait_us=cfg.serve_max_wait_us,
                              queue_depth=cfg.serve_queue_depth,
                              max_inflight=cfg.serve_max_inflight,
                              slo_ms=cfg.serve_slo_ms,
                              adaptive=cfg.serve_adaptive,
+                             resilience=resilience,
                              metrics=metrics).start()
     log.info("dispatch pipeline depth: %d; buckets %s",
              batcher.max_inflight, list(factory.buckets))

@@ -28,6 +28,11 @@ from distributedmnist_tpu.data import synthetic_mnist  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection test (serve/faults.py "
+        "schedules with fixed seeds; cheap and replayable, so chaos "
+        "tests run in tier-1 — `-m 'not slow'` keeps them)")
 
 
 def committed_steps(ckpt_dir: str) -> list:

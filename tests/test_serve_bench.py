@@ -491,6 +491,165 @@ def test_healthz_state_machine_recovers_from_failed_boot():
     assert code == 503 and payload["state"] == "draining"
 
 
+@pytest.mark.chaos
+def test_serve_http_under_injected_restore_failure(tmp_path):
+    """ISSUE 5 satellite: with the boot checkpoint restore failing
+    (injected registry.restore fault — fired before orbax touches
+    disk, so a bare committed-step dir suffices), the server must stay
+    up and honestly 503-unhealthy — never crash, never flap to
+    running. /healthz reports the unhealthy state, GET /models
+    surfaces the failed version WITH last_error, /predict sheds with
+    Retry-After, admin load maps the failure to 409, and SIGTERM still
+    exits clean."""
+    ck = tmp_path / "ck"
+    (ck / "5").mkdir(parents=True)
+    env, repo = worker_env()
+    proc, port = _start_server(
+        repo, env, extra=["--checkpoint-dir", str(ck),
+                          "--serve-faults",
+                          "registry.restore:p=1,error=injected boot "
+                          "restore failure"])
+    try:
+        base = f"http://127.0.0.1:{port}"
+        # the warm thread fails fast; poll until the failed version
+        # shows up, asserting healthz stays 503 the whole way
+        deadline = time.monotonic() + 120
+        versions = []
+        while time.monotonic() < deadline:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/healthz", timeout=10)
+            assert ei.value.code == 503
+            payload = json.loads(ei.value.read())
+            assert payload["ok"] is False
+            assert payload["state"] in ("warming", "failed")
+            assert payload["live_version"] is None
+            versions = _get_json(f"{base}/models")["versions"]
+            if versions and versions[0]["state"] == "failed":
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("failed restore never surfaced in GET /models")
+        failed = versions[0]
+        assert failed["version"] == "step-5"
+        assert "injected boot restore failure" in failed["last_error"]
+        assert failed["last_error_at"] is not None
+
+        # /predict sheds (no live model) with a Retry-After header
+        body = np.full((1, 784), 3, np.uint8).tobytes()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/predict", data=body,
+                                   timeout=10)
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+
+        # admin load hits the same injected failure -> 409, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(f"{base}/models/load", {})
+        assert ei.value.code == 409
+        assert "injected boot restore" in json.loads(
+            ei.value.read())["error"]
+        # still 503 after the failed admin load — no flap to running
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert ei.value.code == 503
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert proc.returncode == 0
+
+
+def test_serve_http_deadline_header_and_rollback_surface():
+    """X-Deadline-Ms end-to-end: malformed -> 400; an already-expired
+    budget -> 504 with a pipeline-derived Retry-After (shed before
+    dispatch); a generous budget serves normally. /healthz carries the
+    rollback surface (zero events on a healthy server) and /metrics
+    the resilience counters + breaker snapshot."""
+    env, repo = worker_env()
+    proc, port = _start_server(repo, env)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        _wait_healthy(base)
+        body = np.full((2, 784), 9, np.uint8).tobytes()
+
+        def predict(deadline_ms):
+            req = urllib.request.Request(
+                f"{base}/predict", data=body,
+                headers={"X-Deadline-Ms": deadline_ms})
+            return json.loads(urllib.request.urlopen(
+                req, timeout=30).read())
+
+        assert predict("30000")["n"] == 2        # generous budget: 200
+
+        for bad in ("not-a-number", "-5", "nan", "inf"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                predict(bad)
+            assert ei.value.code == 400, bad
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            predict("0.0001")                    # expired at submit
+        assert ei.value.code == 504
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert "deadline" in json.loads(ei.value.read())["error"]
+
+        ok = _get_json(f"{base}/healthz")
+        assert ok["rollbacks"] == 0 and ok["last_rollback"] is None
+
+        m = _get_json(f"{base}/metrics")
+        res = m["resilience"]
+        assert res["deadline_shed_requests"] >= 1
+        assert res["rollbacks"] == 0
+        pol = m["resilience_policy"]
+        assert pol["bisect"] is True
+        assert pol["breaker"]["trips"] == 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert proc.returncode == 0
+
+
+@pytest.mark.chaos
+def test_bench_serve_chaos_contract():
+    """`bench.py serve --chaos` (the acceptance-criteria spelling): the
+    seeded fault schedule yields >=1% injected dispatch faults with
+    EXACT poison isolation (cohort-mates all succeed), a forced
+    breaker trip with auto-rollback to the healthy fallback, deadline
+    sheds, availability 1.0 over non-injected traffic, and zero
+    recompiles through the whole storm — plus the git provenance the
+    record now carries."""
+    out = _run_cli("bench.py", ["serve", "--chaos"] + SERVE_ARGS)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip())
+    d = rec["detail"]
+    assert d["host"]["git_commit"] and len(d["host"]["git_commit"]) == 40
+    assert d["host"]["git_dirty"] in (True, False)
+    c = d["chaos"]
+    assert c["requests"] > 100
+    assert c["injected_dispatch_faults"] > 0
+    assert c["poison_isolated_exact"] is True
+    assert c["injected_fetch_faults"] > 0        # the storm really blew
+    assert c["breaker_trips"] == 1
+    assert c["rollbacks"] >= 1
+    assert c["rollback_engaged"] is True
+    assert c["live_version_after"] == "v-chaos-fallback"
+    assert d["live_version_final"] == "v-chaos-fallback"
+    assert c["deadline_shed"] > 0
+    assert c["other_failures"] == 0
+    assert c["availability_ok"] is True
+    assert c["availability_excluding_injected"] >= 0.99
+    assert c["p99_under_faults_ms"] is not None
+    assert c["recompiles_during_chaos"] == 0
+    assert d["recompiles_after_warmup"] == 0     # whole-run discipline
+    assert c["bisect_rescued_requests"] >= 1
+
+
 def test_bench_serve_swap_during_load():
     """`bench.py serve --swap-during-load`: the record carries the swap
     block — a real mid-window load + pre-warm + promote with ZERO
