@@ -168,6 +168,18 @@ def main(argv=None) -> int:
     p.add_argument("--no-adaptive", action="store_true", default=None,
                    help="[serve] pin the static coalescing wait instead "
                         "of the SLO-aware adaptive controller")
+    p.add_argument("--serve-replicas", type=int, default=None,
+                   help="[serve] engine replicas behind the fleet "
+                        "dispatcher (serve/fleet.py); >= 2 adds the "
+                        "fleet closed-loop leg (per-replica balance + "
+                        "scaling efficiency vs one replica) and, with "
+                        "--chaos, a replica-kill storm proving failover "
+                        "rescues the killed replica's cohorts "
+                        "(default 1)")
+    p.add_argument("--serve-hedge", action="store_true", default=None,
+                   help="[serve] enable hedged tail dispatch in the "
+                        "fleet (duplicate overdue batches on a free "
+                        "healthy sibling)")
     p.add_argument("--baseline", default=None, metavar="BENCH_serve.json",
                    help="[serve] a prior BENCH_serve_r*.json to diff "
                         "against: prints a delta table and REFUSES "
@@ -216,6 +228,8 @@ def main(argv=None) -> int:
                    "--serve-max-inflight": args.serve_max_inflight,
                    "--serve-slo-ms": args.serve_slo_ms,
                    "--no-adaptive": args.no_adaptive,
+                   "--serve-replicas": args.serve_replicas,
+                   "--serve-hedge": args.serve_hedge,
                    "--baseline": args.baseline,
                    "--chaos": args.chaos,
                    "--swap-during-load": args.swap_during_load,
@@ -264,6 +278,8 @@ def main(argv=None) -> int:
                 p.error("--serve-qps targets must be positive")
         if args.serve_slo_ms is not None and args.serve_slo_ms <= 0:
             p.error("--serve-slo-ms must be > 0")
+        if args.serve_replicas is not None and args.serve_replicas < 1:
+            p.error("--serve-replicas must be >= 1")
         if args.baseline is not None:
             # An unreadable/shapeless baseline is a usage error NOW; the
             # device_kind REFUSAL must wait for the backend (the worker
@@ -978,6 +994,65 @@ def _serve_ragged_leg(router, metrics, factory, make_batcher,
     return leg
 
 
+def _serve_fleet_leg(fleet, metrics, make_batcher, clients: int,
+                     duration: float, req) -> dict:
+    """The replica-scaling proof leg (ISSUE 6): the SAME fleet measured
+    closed-loop twice — first with every replica but r0 drained (the
+    honest replicas=1 baseline: same engines, same warm state, no
+    rebuild, and the drain/rejoin admin path exercised under load),
+    then with the full fleet — reporting per-replica dispatch balance
+    (the cost-aware pick must spread within 25%) and scaling efficiency
+    (fleet capacity over N x single-replica capacity; ~1.0 on disjoint
+    mesh slices, necessarily < 1 for logical replicas sharing one
+    chip's compute, which the record's provenance block discloses)."""
+    ids = fleet.replica_ids()
+    for rid in ids[1:]:
+        fleet.drain(rid)
+    b = make_batcher(fleet.per_replica_inflight)
+    try:
+        _mark(f"fleet closed loop [1/{len(ids)} replicas]: {clients} "
+              f"clients x {duration:.0f}s")
+        single = _serve_closed_loop(b, metrics, [req], clients, duration)
+    finally:
+        b.stop()
+    for rid in ids[1:]:
+        fleet.rejoin(rid)
+    before = {r["id"]: r["dispatched_batches"]
+              for r in fleet.snapshot()["replicas"]}
+    b = make_batcher(fleet.max_inflight_total)
+    try:
+        _mark(f"fleet closed loop [{len(ids)} replicas]: {clients} "
+              f"clients x {duration:.0f}s")
+        full = _serve_closed_loop(b, metrics, [req], clients, duration)
+    finally:
+        b.stop()
+    counts = {r["id"]: r["dispatched_batches"] - before[r["id"]]
+              for r in fleet.snapshot()["replicas"]}
+    lo, hi = min(counts.values()), max(counts.values())
+    balance_ratio = round(hi / lo, 3) if lo else None
+    single_rate = single["rows_per_sec"]
+    efficiency = (round(full["rows_per_sec"]
+                        / (len(ids) * single_rate), 3)
+                  if single_rate else None)
+    leg = {
+        "replicas": len(ids),
+        "single_replica_rows_per_sec": single_rate,
+        "fleet_rows_per_sec": full["rows_per_sec"],
+        "scaling_efficiency": efficiency,
+        "per_replica_dispatches": counts,
+        "dispatch_balance_ratio": balance_ratio,
+        # ISSUE 6 acceptance: per-replica dispatch counts within 25%
+        "balance_ok": (balance_ratio is not None
+                       and balance_ratio <= 1.25),
+        "single_latency_ms": single["latency_ms"],
+        "fleet_latency_ms": full["latency_ms"],
+    }
+    _mark(f"fleet: {single_rate:.0f} -> {full['rows_per_sec']:.0f} "
+          f"rows/s over {len(ids)} replicas (efficiency {efficiency}), "
+          f"dispatch balance {counts} (ratio {balance_ratio})")
+    return leg
+
+
 def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
                      compiles, pipelined: int, duration: float,
                      qps: float) -> dict:
@@ -1046,6 +1121,32 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
     # rollback turning the leg into a total outage.
     spec = ("batch.dispatch:mode=request,p=0.015;"
             f"engine.fetch:p=1,count=200,after=40,version={live}")
+    # The replica-kill storm (ISSUE 6, fleet runs only): kill one
+    # replica — first at fetch (its in-flight batches die holding
+    # results), then at dispatch (it refuses new work) — via the
+    # per-replica ctx match, leaving its sibling healthy. Every killed
+    # batch must be RESCUED by failover redispatch (failovers > 0, zero
+    # replica faults surfacing as request errors), and the rescue
+    # dispatches reuse the sibling's compiled bucket programs, so the
+    # whole storm stays recompile-free. The kill windows (victim
+    # crossings 3-6 at fetch, 9-12 at dispatch — roughly overall
+    # batches 6-24, the victim serving ~half) deliberately complete
+    # BEFORE the version-pinned fetch storm opens at engine.fetch
+    # evaluation 41: overlapping them would kill a rescue of a
+    # version-storm batch ON the only sibling — unsurvivable with two
+    # replicas by construction, and a different scenario from the
+    # replica fault class this storm exists to prove is absorbed. The
+    # bursts are also small enough that the victim's breaker NEED not
+    # trip for availability to hold — failover, not exclusion, is what
+    # this storm proves.
+    fleet = router if getattr(router, "n_replicas", 1) > 1 else None
+    kill_target = None
+    if fleet is not None:
+        kill_target = fleet.replica_ids()[-1]
+        spec += (f";replica.fetch:p=1,replica={kill_target},"
+                 "after=2,count=4"
+                 f";replica.dispatch:p=1,replica={kill_target},"
+                 "after=8,count=4")
     inj = faults.install(faults.FaultInjector.from_spec(spec, seed=23))
     _mark(f"chaos: schedule {spec!r} (seed 23), {chaos_duration:.0f}s "
           f"open loop at qps={qps:g}, wait {wait_us}us, fallback "
@@ -1103,9 +1204,15 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
     n_ok = outcomes.count("ok")
     n_poison = outcomes.count("injected:batch.dispatch")
     n_fetch = outcomes.count("injected:engine.fetch")
+    # replica-kill faults that ESCAPED failover (no healthy sibling at
+    # rescue time): injected load, excluded from availability, but the
+    # fleet storm's rescued_exactly flag demands ZERO of them
+    n_replica = sum(1 for o in outcomes
+                    if o.startswith("injected:replica."))
     n_deadline = outcomes.count("deadline")
     n_rejected = outcomes.count("rejected")
-    n_other = n - n_ok - n_poison - n_fetch - n_deadline - n_rejected
+    n_other = (n - n_ok - n_poison - n_fetch - n_replica - n_deadline
+               - n_rejected)
     denom = max(n_ok + n_other, 1)
     availability = n_ok / denom
     poisoned = inj.poisoned()
@@ -1125,6 +1232,7 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
         # the injected fault load, split by class
         "injected_dispatch_faults": n_poison,
         "injected_fetch_faults": n_fetch,
+        "injected_replica_faults_surfaced": n_replica,
         "deadline_shed": n_deadline,
         "rejected": n_rejected,
         "other_failures": n_other,
@@ -1148,7 +1256,32 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
         "live_version_after": registry.live_version(),
         "fallback_warmup_compile_events": fallback.warmup_compile_events,
         "recompiles_during_chaos": recompiles,
+        # the fleet's rescue counters (0 without --serve-replicas >= 2):
+        # how many batches redundancy saved that retry could not
+        "failovers": snap["fleet"]["failovers_total"],
+        "hedges": snap["fleet"]["hedges"],
     }
+    if fleet is not None:
+        kill_fires = sum(
+            r["fires"] for r in inj.snapshot()["rules"]
+            if r["point"].startswith("replica."))
+        surfaced_by_point: dict = {}
+        for o in outcomes:
+            if o.startswith("injected:replica."):
+                surfaced_by_point[o] = surfaced_by_point.get(o, 0) + 1
+        leg["replica_kill"] = {
+            "target": kill_target,
+            "fires": kill_fires,
+            "surfaced_failures": n_replica,
+            "surfaced_by_point": surfaced_by_point,
+            # ISSUE 6 acceptance: the killed replica's cohorts were ALL
+            # rescued on the sibling — the storm fired, failover caught
+            # every burst, and no replica fault reached a client
+            "rescued_exactly": kill_fires > 0 and n_replica == 0,
+            "failovers": dict(snap["fleet"]["failovers"]),
+            "replica_trips": snap["fleet"]["replica_trips"],
+            "fleet_after": fleet.snapshot(),
+        }
     _mark(f"chaos: {n} requests — {n_ok} ok, {n_poison} poison culprits "
           f"(unique {len(poisoned)}, exact isolation "
           f"{leg['poison_isolated_exact']}), {n_fetch} trip victims, "
@@ -1173,6 +1306,8 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
         return (round(100.0 * (cur - prev) / prev, 1)
                 if cur is not None and prev else None)
 
+    cur_chaos = cur_d.get("chaos") or {}
+    base_chaos = base_d.get("chaos") or {}
     rows = {
         "img_s_chip": (record["value"], baseline.get("value")),
         "closed_p99_ms": (
@@ -1185,6 +1320,19 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
         "recompiles_after_warmup": (
             cur_d["recompiles_after_warmup"],
             base_d.get("recompiles_after_warmup")),
+        # the chaos-leg signals (ISSUE 6 satellite): resilience must
+        # not regress round-over-round any more than throughput may —
+        # a delta table that only compares the happy path would let an
+        # availability regression ship behind a throughput win. Rows
+        # are None-vs-None when either round ran without --chaos.
+        "chaos_availability": (
+            cur_chaos.get("availability_excluding_injected"),
+            base_chaos.get("availability_excluding_injected")),
+        "chaos_p99_under_faults_ms": (
+            cur_chaos.get("p99_under_faults_ms"),
+            base_chaos.get("p99_under_faults_ms")),
+        "chaos_failovers": (cur_chaos.get("failovers"),
+                            base_chaos.get("failovers")),
     }
     delta = {"path": path,
              "baseline_value": baseline.get("value"),
@@ -1256,7 +1404,9 @@ def _host_provenance(factory) -> dict:
         "cpu_count": os.cpu_count(),
         "backend": factory.platform,
         "device_kind": factory.mesh.devices.flat[0].device_kind,
-        "chip_count": factory.n_chips,
+        # the whole fleet's distinct chips (== the per-replica count on
+        # a single-replica build) — the img/s/chip denominator
+        "chip_count": getattr(factory, "total_chips", factory.n_chips),
         **_git_provenance(),
     }
 
@@ -1352,7 +1502,9 @@ def _serve(args) -> int:
 
     from distributedmnist_tpu.serve import build_resilience
 
-    cfg = Config(model=args.model, dtype=args.dtype)
+    cfg = Config(model=args.model, dtype=args.dtype,
+                 serve_replicas=args.serve_replicas or 1,
+                 serve_hedge=bool(args.serve_hedge))
     metrics = ServeMetrics()
     # Resolve backend-dependent defaults AFTER the backend is up (the
     # same pattern as bench_steps): CPU phases are kept short — each
@@ -1364,12 +1516,18 @@ def _serve(args) -> int:
                          else args.serve_max_batch)), metrics=metrics)
     backend = factory.platform
     on_cpu = backend == "cpu"
-    _mark(f"backend up: {factory.n_chips}x {backend}")
+    _mark(f"backend up: {factory.total_chips}x {backend}")
     if args.serve_max_batch is None and on_cpu:
         # rebuild with the CPU-sized bucket ladder (cheap: CPU compiles
         # are fast and the persistent cache absorbs repeats)
         registry, router, factory = build_serving(
             cfg.replace(serve_max_batch=128), metrics=metrics)
+    # The replica fleet, when benching one (--serve-replicas >= 2): the
+    # fleet leg and the chaos replica-kill storm hang off it; img/s/chip
+    # normalizes by the WHOLE fleet's chips (a 2-replica fleet on 2x
+    # the silicon must not report 2x the per-chip number).
+    fleet = router if getattr(router, "n_replicas", 1) > 1 else None
+    n_chips = factory.total_chips
     # `is None` checks, not `or`: an explicit 0 (e.g. --serve-max-wait-us
     # 0 to measure the no-coalescing latency floor) must be honored.
     max_wait_us = (cfg.serve_max_wait_us if args.serve_max_wait_us is None
@@ -1454,7 +1612,7 @@ def _serve(args) -> int:
     _mark(f"closed loop [inflight=1]: {clients} clients x {duration:.0f}s")
     closed_serial = _serve_closed_loop(serial, metrics, [req], clients,
                                        duration)
-    serial_value = closed_serial["rows_per_sec"] / factory.n_chips
+    serial_value = closed_serial["rows_per_sec"] / n_chips
     _mark(f"closed loop [inflight=1]: {serial_value:.0f} img/s/chip "
           f"(p99 {closed_serial['latency_ms']['p99']} ms)")
     _mark(f"open loop [inflight=1] qps={low_qps:g}")
@@ -1468,7 +1626,7 @@ def _serve(args) -> int:
     _mark(f"closed loop [inflight={piped.max_inflight}]: "
           f"{clients} clients x {duration:.0f}s")
     closed = _serve_closed_loop(piped, metrics, [req], clients, duration)
-    value = closed["rows_per_sec"] / factory.n_chips
+    value = closed["rows_per_sec"] / n_chips
     speedup = value / max(serial_value, 1e-9)
     _mark(f"closed loop [inflight={piped.max_inflight}]: {value:.0f} "
           f"img/s/chip (p99 {closed['latency_ms']['p99']} ms, "
@@ -1482,8 +1640,7 @@ def _serve(args) -> int:
             "qps_target": qps,
             "qps_submitted": round(submitted / duration, 1),
             "requests_per_sec": snap["requests_per_sec"],
-            "img_s_chip": round(snap["rows_per_sec"] / factory.n_chips,
-                                1),
+            "img_s_chip": round(snap["rows_per_sec"] / n_chips, 1),
             "latency_ms": snap["latency_ms"],
             "mean_rows_per_batch": snap["mean_rows_per_batch"],
             "batch_occupancy": snap["batch_occupancy"],
@@ -1545,6 +1702,16 @@ def _serve(args) -> int:
               f"{swap['recompiles_after_swap']} recompiles after swap")
     piped.stop()
 
+    # Phase 4b (fleet runs only) — the replica-scaling leg (ISSUE 6):
+    # the same warmed fleet closed-loop at one active replica (siblings
+    # drained) and at full strength, for the dispatch-balance and
+    # scaling-efficiency numbers. Uses the admin drain/rejoin path
+    # itself, so the bench exercises it on every fleet run.
+    fleet_leg = None
+    if fleet is not None:
+        fleet_leg = _serve_fleet_leg(fleet, metrics, make_batcher,
+                                     clients, duration, req)
+
     # Phase 5 (optional) — the chaos leg (ISSUE 5 acceptance): seeded
     # fault schedule against the resilience stack, after the clean
     # phases so an injected storm can't contaminate the happy-path
@@ -1583,7 +1750,7 @@ def _serve(args) -> int:
             "model": args.model,
             "dtype": args.dtype,
             "backend": backend,
-            "n_chips": factory.n_chips,
+            "n_chips": n_chips,
             # Provenance: where this number was measured. CPU-host
             # numbers (like the 1.08x PR 2 result) must never be
             # conflated with TPU headlines when comparing rounds — the
@@ -1611,6 +1778,27 @@ def _serve(args) -> int:
             "ragged": ragged,
             "swap": swap,
             "chaos": chaos,
+            # The fleet block (ISSUE 6; None on single-replica runs):
+            # per-replica provenance — which devices each replica owns
+            # and whether the slices are disjoint silicon or logical
+            # replicas on shared chips — plus the scaling leg and the
+            # end-of-run fleet state (dispatch totals, failovers,
+            # health).
+            "replicas": ({
+                "count": fleet.n_replicas,
+                "per_replica_inflight": fleet.per_replica_inflight,
+                "per_replica_chips": factory.n_chips,
+                "disjoint_devices": (factory.total_chips
+                                     == factory.n_chips
+                                     * fleet.n_replicas),
+                "provenance": [
+                    {"id": rep.rid,
+                     "devices": [str(d) for d in
+                                 factory.meshes[i].devices.flat]}
+                    for i, rep in enumerate(fleet.replicas)],
+                "fleet_leg": fleet_leg,
+                "final": fleet.snapshot(),
+            } if fleet is not None else None),
             # The measured overlap win (ISSUE 2 acceptance): pipelined
             # capacity over the serial chain, and sub-capacity open-loop
             # latency at both depths — pipelining must buy throughput

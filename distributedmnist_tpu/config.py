@@ -147,6 +147,22 @@ class Config:
     serve_breaker_min_requests: int = 20
     serve_breaker_ratio: float = 0.5
     serve_faults: Optional[str] = None
+    # replica fleet (ISSUE 6, serve/fleet.py): serve_replicas > 1 puts
+    # N engine replicas (mesh slices when the devices divide evenly,
+    # logical replicas otherwise) behind the health-tracked
+    # load-balancing dispatcher — per-replica in-flight windows of
+    # serve_replica_inflight batches (None = the serve_max_inflight
+    # auto rule, per replica), failover redispatch of a batch whose
+    # replica dies, and (serve_hedge) hedged duplicates for batches
+    # already past the p95 cost estimate. serve_retry_after_cap_s caps
+    # the pipeline-derived Retry-After header on every shed response:
+    # the derived value is unbounded when the in-flight window is deep
+    # and a measured batch cost spikes, and RFC 9110 integer seconds
+    # past ~30s just tell clients to go away.
+    serve_replicas: int = 1
+    serve_replica_inflight: Optional[int] = None
+    serve_hedge: bool = False
+    serve_retry_after_cap_s: float = 30.0
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -287,6 +303,25 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "(serve/faults.py spec string, e.g. "
                         "'engine.fetch:p=0.01,latency_ms=5'); chaos "
                         "testing only — default: all failpoints inert")
+    p.add_argument("--serve-replicas", type=int, default=None,
+                   help="[serving] engine replicas behind the "
+                        "load-balancing fleet dispatcher (mesh slices "
+                        "when devices divide evenly, logical replicas "
+                        "otherwise); 1 = the single-engine path")
+    p.add_argument("--serve-replica-inflight", type=int, default=None,
+                   help="[serving] per-replica bounded in-flight window "
+                        "in batches (default: the serve-max-inflight "
+                        "auto rule, applied per replica)")
+    p.add_argument("--serve-hedge", dest="serve_hedge",
+                   action="store_true", default=None,
+                   help="[serving] hedge batches already past the p95 "
+                        "cost estimate with a duplicate dispatch on a "
+                        "free healthy sibling replica (first result "
+                        "wins)")
+    p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
+                   help="[serving] ceiling on the pipeline-derived "
+                        "Retry-After header (integer seconds per "
+                        "RFC 9110) on shed responses")
     p.add_argument("--no-flat-optimizer", dest="flat_optimizer",
                    action="store_false", default=None,
                    help="per-leaf optimizer update instead of the fused "

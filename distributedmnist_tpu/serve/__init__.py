@@ -18,8 +18,12 @@ north star's "serves heavy traffic from millions of users".
               hot-swap, shadow duplication, canary splitting
 - faults.py   config-driven fault injection: named failpoints woven
               through every serving layer, fully inert when disabled
-- resilience.py deadline shedding, poison-batch bisection policy, and
-              the per-version circuit breaker with auto-rollback
+- resilience.py deadline shedding, poison-batch bisection policy, the
+              per-version circuit breaker with auto-rollback, and the
+              sliding-window HealthTracker the fleet scores replicas by
+- fleet.py    fault-tolerant replica set (ISSUE 6): health-tracked
+              cost-aware dispatch over N per-replica routers, failover
+              redispatch, hedged tails, drain/rejoin
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -68,6 +72,12 @@ _EXPORTS = {
                          "ResiliencePolicy"),
     "build_resilience": ("distributedmnist_tpu.serve.resilience",
                          "build_resilience"),
+    "HealthTracker": ("distributedmnist_tpu.serve.resilience",
+                      "HealthTracker"),
+    "ReplicaSet": ("distributedmnist_tpu.serve.fleet", "ReplicaSet"),
+    "FleetHandle": ("distributedmnist_tpu.serve.fleet", "FleetHandle"),
+    "NoReplicaAvailable": ("distributedmnist_tpu.serve.fleet",
+                           "NoReplicaAvailable"),
 }
 
 __all__ = list(_EXPORTS)

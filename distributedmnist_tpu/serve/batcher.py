@@ -147,8 +147,19 @@ class DynamicBatcher:
             self.max_wait_s,
             slo_s=slo_ms / 1e3 if slo_ms is not None else None,
             max_batch=self.max_batch) if adaptive else None)
-        self.max_inflight = resolve_max_inflight(
-            max_inflight, getattr(engine, "platform", "cpu"))
+        # Slot accounting is fleet-aware (ISSUE 6): a ReplicaSet
+        # enforces its own bounded PER-REPLICA windows inside dispatch,
+        # and advertises their aggregate as max_inflight_total — on
+        # auto, the batcher's window opens to exactly that, so the
+        # queue can keep every replica's window fed instead of
+        # throttling N replicas behind one replica's depth. An explicit
+        # max_inflight still wins (bench phases pin it).
+        fleet_total = getattr(engine, "max_inflight_total", None)
+        if max_inflight is None and fleet_total is not None:
+            self.max_inflight = fleet_total
+        else:
+            self.max_inflight = resolve_max_inflight(
+                max_inflight, getattr(engine, "platform", "cpu"))
         self._q: deque[_Request] = deque()
         self._rows = 0                   # pending rows, watermark basis
         self._cond = threading.Condition()
@@ -579,9 +590,13 @@ class DynamicBatcher:
                 # canary population's metrics separate from the live
                 # population's. Bare-engine handles tag None (untagged).
                 self.metrics.record_fetch(t_done - t0)
+                # The replica tag (fleet handles only) names the replica
+                # that COMPUTED the batch — after a failover rescue that
+                # is the sibling, not the replica originally picked.
                 self.metrics.record_batch(
                     rows=rows, bucket=handle.bucket,
-                    queue_depth=self.pending_rows(), version=version)
+                    queue_depth=self.pending_rows(), version=version,
+                    replica=getattr(handle, "replica", None))
                 for r in batch:
                     self.metrics.record_latency(t_done - r.t_enqueue,
                                                 rows=r.n, version=version)

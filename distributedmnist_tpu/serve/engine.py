@@ -139,6 +139,12 @@ class InferenceEngine:
         # costs are predictable, so keep them instead of throwing the
         # warmup timings away. Empty until warmup() runs.
         self._bucket_cost: dict[int, float] = {}
+        # The tail sibling of the median table: per-bucket p95 dispatch
+        # cost from the same warmup samples. The fleet's hedged
+        # dispatch (serve/fleet.py) triggers on "this batch is already
+        # slower than the p95 estimate" — a threshold the MEDIAN would
+        # set too aggressively (half of all healthy batches exceed it).
+        self._bucket_cost_p95: dict[int, float] = {}
 
     # -- bucketing ---------------------------------------------------------
 
@@ -258,6 +264,7 @@ class InferenceEngine:
         leaves the more-settled second measurement in place)."""
         before = self._compiles.snapshot()
         costs = {}
+        costs_p95 = {}
         for b in self.buckets:
             x = np.zeros((b, *IMAGE_SHAPE), np.uint8)
             self.infer(x)              # compile (or cache hit) first —
@@ -267,10 +274,14 @@ class InferenceEngine:
                 self.infer(x)
                 samples.append(time.perf_counter() - t0)
             costs[b] = statistics.median(samples)
+            samples.sort()
+            costs_p95[b] = samples[min(len(samples) - 1,
+                                       int(0.95 * len(samples)))]
         # One reference swap, not per-bucket mutation: a dispatch-thread
         # bucket_costs() read mid-warmup sees the old complete table or
         # the new complete table, never a half-written one.
         self._bucket_cost = costs
+        self._bucket_cost_p95 = costs_p95
         n = self._compiles.snapshot() - before
         log.info("serve engine warm: %d buckets %s (%d compile events); "
                  "bucket cost ms %s",
@@ -285,6 +296,13 @@ class InferenceEngine:
         per-dispatch host overhead is included). Empty before warmup —
         the batch former treats that as 'no cost model, don't split'."""
         return self._bucket_cost
+
+    def bucket_costs_p95(self) -> dict[int, float]:
+        """p95 seconds-per-dispatch per bucket from the warmup samples
+        — the hedge-trigger price list (a batch slower than this is
+        already in its tail). Empty before warmup, which disables
+        hedging the same way it disables the batch former."""
+        return self._bucket_cost_p95
 
     def compile_events(self) -> int:
         """Process-wide compile-request count (utils.CompileCounter);

@@ -14,6 +14,11 @@ faults can be produced deterministically, on demand, in tests and in
     router.shadow      the shadow duplicate dispatch in Router
     registry.restore   ModelRegistry.load_latest's checkpoint restore
     registry.warmup    ModelRegistry.add's engine build + warmup
+    replica.dispatch   the fleet's per-replica dispatch (ctx: replica —
+                       a rule with replica=r1 kills exactly that
+                       replica, the chaos bench's replica-kill storm)
+    replica.fetch      the fleet's per-replica fetch (ctx: replica,
+                       version)
 
 — each a single call to failpoint(name, **ctx). With no injector
 installed that call is one module-global None check: the production hot
@@ -64,7 +69,8 @@ from typing import Optional
 # fires).
 KNOWN_FAILPOINTS = frozenset((
     "engine.dispatch", "engine.fetch", "batch.dispatch",
-    "router.shadow", "registry.restore", "registry.warmup"))
+    "router.shadow", "registry.restore", "registry.warmup",
+    "replica.dispatch", "replica.fetch"))
 
 
 class InjectedFault(RuntimeError):

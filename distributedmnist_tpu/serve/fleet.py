@@ -1,0 +1,672 @@
+"""Fault-tolerant replica fleet (ISSUE 6): health-tracked dispatch,
+failover redispatch, and hedged tails over N engine replicas.
+
+Everything serve-side before this PR ran ONE engine on one mesh: a
+wedged or faulted engine was a full outage, and PR 5's circuit breaker
+could only roll the *version*, not route around a sick *replica*. This
+module makes redundancy — not just retry — the failure-handling
+primitive, the way Clockwork isolates workers behind a controller that
+stops sending to lagging ones and Clipper sheds at the front door
+instead of absorbing a sick backend's queueing delay (PAPERS.md).
+
+A **ReplicaSet** is engine-shaped (dispatch()/fetch(), max_batch /
+buckets / platform, _as_images, bucket_costs) so it sits exactly where
+the single Router sits today — the batcher cannot tell the difference.
+Inside, N replicas each own a full per-replica Router over their own
+InferenceEngines (mesh-slice devices when the host has enough chips,
+N logical replicas sharing the mesh otherwise; serve/registry.py fans
+every version's warm + promote out to all of them, so a roll never
+leaves the fleet serving mixed versions). Per dispatch:
+
+- **cost-aware least-loaded pick**: each replica holds a bounded
+  in-flight window (`per_replica_inflight` batches) and an outstanding
+  cost gauge priced by the PR 4 warmup-measured bucket cost tables;
+  the pick takes the cheapest-backlog healthy replica, with total
+  dispatches as the tiebreak (degrades to round-robin when no cost
+  table exists yet).
+- **health-tracked exclusion**: every batch outcome feeds a
+  per-replica sliding-window HealthTracker AND a per-replica
+  CircuitBreaker (serve/resilience.py). A tripped replica is excluded
+  from picks until its cooldown lapses — automatic drain on sickness,
+  automatic rejoin on recovery. If EVERY replica is tripped the pick
+  degrades to least-loaded anyway (limp mode): a grim health window
+  must never turn into a self-inflicted total outage.
+- **failover redispatch**: a batch whose replica dies at dispatch or
+  fetch is retried ONCE on a healthy sibling before the failure ever
+  reaches the batcher (where PR 5 bisection would run) — a replica
+  fault costs latency, not errors. The handle keeps the host-side
+  payload until fan-out precisely so a fetch-side death can be
+  re-dispatched; failovers re-tag the handle's (version, replica) so
+  attribution follows the replica that actually computed the result.
+  503-shaped errors (NoLiveModel while warming) are systemic, not
+  replica faults: every sibling would refuse identically, so they
+  propagate without failover or health blame.
+- **hedged dispatch** (optional, `serve_hedge`): a batch that reaches
+  its fetch already slower than `hedge_factor x` the live p95 cost
+  estimate for its bucket (the engine's warmup-measured tail table) is
+  raced against a duplicate on a free healthy sibling; first result
+  wins, the loser drains in the background. Tail latency from a slow-
+  but-alive replica is bounded by a fresh dispatch elsewhere — the
+  classic tail-at-scale hedge, gated so it only spends duplicate work
+  when the tail is already blown and a sibling has spare capacity.
+- **drain / rejoin**: `drain(rid)` removes a replica from the pick set
+  while its in-flight batches finish (admin POST /replicas/{id}/drain);
+  `rejoin(rid)` restores it with a fresh health slate. Draining the
+  last active replica is refused — an operator emptying the fleet by
+  accident should get a 409, not an outage.
+
+Failpoints `replica.dispatch` / `replica.fetch` (serve/faults.py) wrap
+the per-replica hops with ctx={replica, ...}, so a chaos schedule can
+kill exactly one replica (`replica.fetch:p=1,replica=r1`) and the bench
+can prove the storm is absorbed by failover: availability 1.0, zero
+recompiles (rescue and hedge dispatches reuse compiled bucket programs
+on the sibling — never a new shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from distributedmnist_tpu.serve.batcher import resolve_max_inflight
+from distributedmnist_tpu.serve.engine import InferenceEngine
+from distributedmnist_tpu.serve.faults import failpoint
+from distributedmnist_tpu.serve.resilience import (CircuitBreaker,
+                                                   HealthTracker)
+
+log = logging.getLogger("distributedmnist_tpu")
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is draining (or the fleet is empty): new work is
+    shed with 503 semantics — systemic like NoLiveModel, so the batcher
+    neither bisects it nor blames a version or replica for it."""
+
+    status = 503
+
+
+@dataclasses.dataclass
+class _Replica:
+    """One member of the fleet: its Router plus the live accounting the
+    pick runs on. All mutable fields are guarded by the ReplicaSet's
+    condition lock."""
+
+    rid: str
+    router: Any
+    state: str = "active"            # "active" | "draining"
+    inflight: int = 0                # reserved dispatch slots
+    outstanding_s: float = 0.0       # est. cost of reserved work
+    last_pick: int = 0               # fleet pick sequence, LRU tiebreak
+    dispatched_batches: int = 0
+    dispatched_rows: int = 0
+    failures: int = 0
+
+
+@dataclasses.dataclass
+class FleetHandle:
+    """A dispatched batch plus everything failover needs: the replica
+    that holds it, the reserve cost to release at completion, and the
+    ORIGINAL host payload — a fetch-side replica death can only be
+    redispatched because the input outlives the staging buffer. The
+    (version, replica) tags are re-stamped when failover or a winning
+    hedge moves the computation, so the batcher's metrics attribution
+    always names the replica/version that produced the result."""
+
+    inner: Any                      # the replica Router's RoutedHandle
+    replica: str
+    version: Optional[str]
+    n: int
+    bucket: int
+    x: Any                          # host payload, for redispatch
+    cost_s: float                   # reserved estimate, released as-is
+    t_dispatch: float
+
+
+class ReplicaSet:
+    """Engine-shaped load-balancing dispatcher over N replica Routers.
+
+    The registry drives the version surface (set_live/set_shadow/
+    set_canary fan out to every replica under the fleet lock, so no
+    batch can be picked mid-roll); the batcher drives dispatch()/
+    fetch() exactly as it drives a single Router. n_replicas >= 2:
+    a one-replica fleet is just a Router with overhead — build_serving
+    keeps the single-router path for that."""
+
+    HEDGE_FACTOR = 3.0
+
+    def __init__(self, routers: Sequence, metrics=None,
+                 per_replica_inflight: Optional[int] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 health: Optional[HealthTracker] = None,
+                 hedge: bool = False,
+                 hedge_factor: Optional[float] = None):
+        if len(routers) < 2:
+            raise ValueError(
+                f"a fleet needs >= 2 replicas, got {len(routers)} "
+                "(single-replica serving uses a bare Router)")
+        first = routers[0]
+        for r in routers[1:]:
+            if (tuple(r.buckets) != tuple(first.buckets)
+                    or r.max_batch != first.max_batch):
+                raise ValueError(
+                    "replica geometry mismatch: all replicas must share "
+                    "one bucket ladder / max_batch")
+        self.replicas = [_Replica(rid=r.replica or f"r{i}", router=r)
+                         for i, r in enumerate(routers)]
+        self._by_id = {r.rid: r for r in self.replicas}
+        if len(self._by_id) != len(self.replicas):
+            raise ValueError("duplicate replica ids")
+        self.max_batch = first.max_batch
+        self.buckets = tuple(first.buckets)
+        self.platform = first.platform
+        self.n_chips = first.n_chips           # PER-REPLICA chip count
+        self.metrics = metrics
+        self.per_replica_inflight = resolve_max_inflight(
+            per_replica_inflight, self.platform)
+        # A tighter default window than the version breaker: a replica
+        # is cheap to exclude (siblings absorb its share) and cheap to
+        # re-admit (cooldown lapse), so trip fast, recover fast.
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            window_s=5.0, min_requests=8, failure_ratio=0.5,
+            cooldown_s=10.0)
+        self.health = health if health is not None else HealthTracker()
+        self.hedge = hedge
+        self.hedge_factor = (self.HEDGE_FACTOR if hedge_factor is None
+                             else hedge_factor)
+        self._cond = threading.Condition()
+        self._pick_seq = 0
+        self._failovers_dispatch = 0
+        self._failovers_fetch = 0
+        self._hedges = 0
+        self._hedge_wins = 0
+        self._replica_trips = 0
+
+    # Engine-shape parity (same borrow the Router makes).
+    _as_images = staticmethod(InferenceEngine._as_images)
+    bucket_for = InferenceEngine.bucket_for
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def max_inflight_total(self) -> int:
+        """The fleet's aggregate dispatch window: the batcher sizes its
+        own in-flight semaphore to this when serve_max_inflight is
+        left on auto, so the queue keeps every replica's window fed."""
+        return self.per_replica_inflight * len(self.replicas)
+
+    def replica_ids(self) -> list[str]:
+        return [r.rid for r in self.replicas]
+
+    # -- version wiring: the registry's fan-out surface -------------------
+
+    def set_live(self, engines: Sequence, version: str) -> None:
+        """Atomic fleet-wide swap: every replica's router re-points to
+        its own engine of `version` under the pick lock, so no batch
+        can be dispatched between replica k and k+1 taking the new
+        version — a roll never leaves a mixed-version pick window."""
+        self._check_fanout(engines)
+        with self._cond:
+            for rep, eng in zip(self.replicas, engines):
+                rep.router.set_live(eng, version)
+
+    def set_shadow(self, engines: Sequence, version: str,
+                   fraction: float) -> None:
+        self._check_fanout(engines)
+        with self._cond:
+            for rep, eng in zip(self.replicas, engines):
+                rep.router.set_shadow(eng, version, fraction)
+
+    def set_canary(self, engines: Sequence, version: str,
+                   fraction: float) -> None:
+        self._check_fanout(engines)
+        with self._cond:
+            for rep, eng in zip(self.replicas, engines):
+                rep.router.set_canary(eng, version, fraction)
+
+    def clear_candidates(self) -> None:
+        with self._cond:
+            for rep in self.replicas:
+                rep.router.clear_candidates()
+
+    def _check_fanout(self, engines: Sequence) -> None:
+        if len(engines) != len(self.replicas):
+            raise ValueError(
+                f"fan-out needs one engine per replica: got "
+                f"{len(engines)} for {len(self.replicas)} replicas")
+
+    def live_version(self) -> Optional[str]:
+        return self.replicas[0].router.live_version()
+
+    def routes(self) -> dict:
+        # identical across replicas by construction (every mutation
+        # fans out under the fleet lock); replica 0 speaks for all
+        return self.replicas[0].router.routes()
+
+    def versions_in_route(self) -> set:
+        out: set = set()
+        for rep in self.replicas:
+            out |= rep.router.versions_in_route()
+        return out
+
+    def bucket_costs(self) -> dict:
+        return self.replicas[0].router.bucket_costs()
+
+    def bucket_costs_p95(self) -> dict:
+        return self.replicas[0].router.bucket_costs_p95()
+
+    # -- the pick ----------------------------------------------------------
+
+    def _cost(self, bucket: int) -> float:
+        costs = self.bucket_costs()
+        return costs.get(bucket, 0.0) if costs else 0.0
+
+    def _pick(self, cost_s: float, exclude: frozenset = frozenset(),
+              block: bool = True, overflow: bool = False,
+              healthy_only: bool = False) -> Optional[_Replica]:
+        """Reserve a dispatch slot on the best replica. Healthy (not
+        breaker-cooled) active replicas with free window credit win by
+        least outstanding cost; every replica tripped degrades to
+        least-loaded among active (limp mode — never a self-inflicted
+        outage). block=True (the primary dispatch path) waits for
+        credit; block=False (failover/hedge, called on the completion
+        thread which is the very thread that frees credit — waiting
+        would deadlock) returns None, or over-commits when `overflow`
+        (a rescue may transiently exceed the window; a hedge may not).
+        The slot (inflight + outstanding cost) is reserved HERE, under
+        the lock, so concurrent pickers can never oversubscribe a
+        replica past its window."""
+        with self._cond:
+            while True:
+                active = [r for r in self.replicas
+                          if r.state == "active" and r.rid not in exclude]
+                if not active:
+                    if exclude:
+                        return None       # no sibling to rescue/hedge on
+                    raise NoReplicaAvailable(
+                        "every replica is draining — fleet takes no new "
+                        "work")
+                healthy = [r for r in active
+                           if not self.breaker.in_cooldown(r.rid)]
+                if healthy_only and not healthy:
+                    # hedge picks: a duplicate on a breaker-tripped
+                    # sibling is guaranteed wasted work — better no
+                    # hedge than a sick one. Rescues and primary
+                    # dispatch still get the limp-mode fallback below.
+                    return None
+                pool = healthy or active    # limp mode
+                free = [r for r in pool
+                        if r.inflight < self.per_replica_inflight]
+                cands = free or (pool if (not block and overflow) else [])
+                if cands:
+                    # Ties (idle symmetric replicas) break by LEAST
+                    # RECENTLY PICKED — stateless round-robin. A
+                    # cumulative-count tiebreak would flood a freshly
+                    # rejoined replica until its lifetime total caught
+                    # up with siblings that served through its absence.
+                    rep = min(cands, key=lambda r: (
+                        r.outstanding_s, r.inflight, r.last_pick))
+                    self._pick_seq += 1
+                    rep.last_pick = self._pick_seq
+                    rep.inflight += 1
+                    rep.outstanding_s += cost_s
+                    return rep
+                if not block:
+                    return None
+                self._cond.wait(0.05)
+
+    def _release(self, rep: _Replica, cost_s: float) -> None:
+        with self._cond:
+            rep.inflight -= 1
+            rep.outstanding_s = max(rep.outstanding_s - cost_s, 0.0)
+            self._cond.notify_all()
+
+    def _mark_dispatched(self, rep: _Replica, rows: int) -> None:
+        with self._cond:
+            rep.dispatched_batches += 1
+            rep.dispatched_rows += rows
+
+    def _record(self, rep: _Replica, ok: bool, n: int = 1,
+                latency_s: Optional[float] = None) -> None:
+        """One replica-attributed outcome: feeds the health window and
+        the per-replica breaker; a trip is logged + counted (the pick
+        excludes the replica for the cooldown — no rollback here, a
+        sick replica is routed around, not demoted: sick replica !=
+        sick version)."""
+        self.health.record(rep.rid, ok, n=n, latency_s=latency_s)
+        if not ok:
+            with self._cond:
+                rep.failures += 1
+        if self.breaker.record(rep.rid, ok, n=n):
+            with self._cond:
+                self._replica_trips += 1
+            log.warning(
+                "fleet: replica %s TRIPPED its breaker — excluded from "
+                "dispatch for %.1fs (siblings absorb its share)",
+                rep.rid, self.breaker.cooldown_s)
+            if self.metrics is not None:
+                self.metrics.record_replica_trip(rep.rid)
+
+    # -- the engine surface the batcher drives -----------------------------
+
+    def dispatch(self, x) -> FleetHandle:
+        parts = ([self._as_images(p) for p in x]
+                 if isinstance(x, (list, tuple))
+                 else [self._as_images(x)])
+        n = sum(p.shape[0] for p in parts)
+        bucket = self.bucket_for(n)
+        cost_s = self._cost(bucket)
+        rep = self._pick(cost_s)
+        try:
+            return self._dispatch_on(rep, parts, n, bucket, cost_s)
+        except Exception as e:
+            self._release(rep, cost_s)
+            if getattr(e, "status", None) == 503:
+                raise             # systemic: every sibling would refuse
+            self._record(rep, ok=False)
+            sib = self._pick(cost_s, exclude=frozenset((rep.rid,)),
+                             block=False, overflow=True)
+            if sib is None:
+                raise
+            try:
+                fh = self._dispatch_on(sib, parts, n, bucket, cost_s)
+            except Exception as e2:
+                self._release(sib, cost_s)
+                self._record(sib, ok=False)
+                # same root-cause rule as the fetch rescue: the batch
+                # is attributed to its PRIMARY failure, the failed
+                # rescue is logged
+                log.warning("fleet: rescue dispatch on %s failed too "
+                            "(%s)", sib.rid, e2)
+                raise e
+            with self._cond:
+                self._failovers_dispatch += 1
+            if self.metrics is not None:
+                self.metrics.record_failover("dispatch", rep.rid, sib.rid)
+            log.warning("fleet: dispatch failover %s -> %s (%s)",
+                        rep.rid, sib.rid, e)
+            return fh
+
+    def _dispatch_on(self, rep: _Replica, parts: list, n: int,
+                     bucket: int, cost_s: float) -> FleetHandle:
+        """One replica-targeted dispatch (slot already reserved by the
+        caller's pick; the caller releases it on failure)."""
+        failpoint("replica.dispatch", replica=rep.rid, rows=n,
+                  bucket=bucket)
+        rh = rep.router.dispatch(parts)
+        self._mark_dispatched(rep, n)
+        return FleetHandle(inner=rh, replica=rep.rid, version=rh.version,
+                           n=rh.n, bucket=rh.bucket, x=parts,
+                           cost_s=cost_s, t_dispatch=time.monotonic())
+
+    def _fetch_on(self, rep: _Replica, fh_or_rh, version, n: int
+                  ) -> np.ndarray:
+        failpoint("replica.fetch", replica=rep.rid, version=version,
+                  rows=n)
+        return rep.router.fetch(fh_or_rh)
+
+    def fetch(self, fh: FleetHandle) -> np.ndarray:
+        rep = self._by_id[fh.replica]
+        if self.hedge:
+            threshold = self._hedge_threshold(fh.bucket)
+            if (threshold is not None
+                    and time.monotonic() - fh.t_dispatch > threshold):
+                sib = self._pick(fh.cost_s,
+                                 exclude=frozenset((rep.rid,)),
+                                 block=False, overflow=False,
+                                 healthy_only=True)
+                if sib is not None:
+                    return self._fetch_hedged(fh, rep, sib)
+        try:
+            out = self._fetch_on(rep, fh.inner, fh.version, fh.n)
+        except Exception as e:
+            self._release(rep, fh.cost_s)
+            if getattr(e, "status", None) == 503:
+                raise             # systemic: not this replica's fault
+            self._record(rep, ok=False)
+            return self._failover_fetch(fh, rep, e)
+        self._release(rep, fh.cost_s)
+        self._record(rep, ok=True,
+                     latency_s=time.monotonic() - fh.t_dispatch)
+        return out
+
+    def _failover_fetch(self, fh: FleetHandle, failed: _Replica,
+                        cause: Exception) -> np.ndarray:
+        """The batch's replica died at fetch: redispatch the retained
+        payload once on a healthy sibling, inline (the completion
+        thread is already dedicated to this batch — FIFO order is
+        preserved, the rescue just extends this batch's service time).
+        The sibling pick may over-commit its window: rescuing held work
+        beats strict admission. A second failure propagates — the
+        batcher's bisection/breaker path takes over, exactly as if the
+        fleet were a single engine that failed."""
+        sib = self._pick(fh.cost_s, exclude=frozenset((failed.rid,)),
+                         block=False, overflow=True)
+        if sib is None:
+            raise cause
+        # A failed rescue propagates the ORIGINAL cause: the batch's
+        # root failure is the primary's fault, and the client-visible
+        # (and bench-classified) outcome must name it — a rescue dying
+        # of something else (say an injected fault matched on the
+        # rescuing replica while the primary died of a version fault)
+        # is a secondary event that belongs in the log, not in the
+        # batch's attribution.
+        try:
+            rescued = self._dispatch_on(sib, fh.x, fh.n, fh.bucket,
+                                        fh.cost_s)
+        except Exception as e2:
+            self._release(sib, fh.cost_s)
+            self._record(sib, ok=False)
+            log.warning("fleet: rescue dispatch on %s failed too (%s)",
+                        sib.rid, e2)
+            raise cause
+        log.warning("fleet: fetch failover %s -> %s (%s)",
+                    failed.rid, sib.rid, cause)
+        try:
+            out = self._fetch_on(sib, rescued.inner, rescued.version,
+                                 fh.n)
+        except Exception as e2:
+            self._release(sib, fh.cost_s)
+            self._record(sib, ok=False)
+            log.warning("fleet: rescue fetch on %s failed too (%s)",
+                        sib.rid, e2)
+            raise cause
+        self._release(sib, fh.cost_s)
+        # The sibling's health is scored on ITS OWN service time (the
+        # rescue dispatch onward): charging the dead primary's delay to
+        # the replica that saved the batch would point the per-replica
+        # latency signal at the wrong replica.
+        self._record(sib, ok=True,
+                     latency_s=time.monotonic() - rescued.t_dispatch)
+        # A failover is counted only once the rescue actually LANDED
+        # (dispatch + fetch): the counter's contract is "batches
+        # redundancy saved", and a rescue that fails the same way the
+        # primary did (e.g. a version-pinned fault present on every
+        # replica) saved nothing.
+        with self._cond:
+            self._failovers_fetch += 1
+        if self.metrics is not None:
+            self.metrics.record_failover("fetch", failed.rid, sib.rid)
+        # Attribution follows the computation: the sibling's version
+        # may differ from the original dispatch's (a roll landed in
+        # between) — the re-tag keeps by_version/by_replica honest.
+        fh.replica, fh.version = sib.rid, rescued.version
+        return out
+
+    def _hedge_threshold(self, bucket: int) -> Optional[float]:
+        p95 = self.bucket_costs_p95()
+        if not p95 or bucket not in p95:
+            return None           # no tail estimate yet: never hedge
+        return self.hedge_factor * p95[bucket]
+
+    def _fetch_hedged(self, fh: FleetHandle, rep: _Replica,
+                      sib: _Replica) -> np.ndarray:
+        """Race the overdue primary fetch against a duplicate on `sib`
+        (slot already reserved by the caller's pick): first success
+        wins, the loser finishes on its own daemon thread — its
+        engine recycles staging in fetch()'s finally, its accounting
+        lands in its runner, nothing leaks. Hedges are rare by
+        construction (past the p95 threshold AND a free healthy
+        sibling), so the two short-lived threads per hedge are noise."""
+        cv = threading.Condition()
+        results: dict = {}            # tag -> (ok, value) in arrival order
+
+        def finish(tag, ok, value):
+            with cv:
+                results[tag] = (ok, value)
+                cv.notify_all()
+
+        def run_primary():
+            try:
+                out = self._fetch_on(rep, fh.inner, fh.version, fh.n)
+            except Exception as e:
+                self._release(rep, fh.cost_s)
+                self._record(rep, ok=False)
+                finish("primary", False, e)
+                return
+            self._release(rep, fh.cost_s)
+            self._record(rep, ok=True,
+                         latency_s=time.monotonic() - fh.t_dispatch)
+            finish("primary", True, out)
+
+        def run_hedge():
+            try:
+                dup = self._dispatch_on(sib, fh.x, fh.n, fh.bucket,
+                                        fh.cost_s)
+            except Exception as e:
+                self._release(sib, fh.cost_s)
+                self._record(sib, ok=False)
+                finish("hedge", False, e)
+                return
+            try:
+                out = self._fetch_on(sib, dup.inner, dup.version, fh.n)
+            except Exception as e:
+                self._release(sib, fh.cost_s)
+                self._record(sib, ok=False)
+                finish("hedge", False, e)
+                return
+            self._release(sib, fh.cost_s)
+            # scored on the hedge's own dispatch-to-result time, not
+            # the overdue primary's elapsed window (same attribution
+            # rule as the failover rescue)
+            self._record(sib, ok=True,
+                         latency_s=time.monotonic() - dup.t_dispatch)
+            finish("hedge", True, (out, dup.version, sib.rid))
+
+        with self._cond:
+            self._hedges += 1
+        for target in (run_primary, run_hedge):
+            threading.Thread(target=target, name="serve-hedge",
+                             daemon=True).start()
+        with cv:
+            while True:
+                for tag, (ok, value) in results.items():
+                    if ok:
+                        hedge_won = tag == "hedge"
+                        if hedge_won:
+                            with self._cond:
+                                self._hedge_wins += 1
+                            out, version, rid = value
+                            fh.replica, fh.version = rid, version
+                        else:
+                            out = value
+                        if self.metrics is not None:
+                            self.metrics.record_hedge(win=hedge_won)
+                        return out
+                if len(results) == 2:   # both failed
+                    if self.metrics is not None:
+                        self.metrics.record_hedge(win=False)
+                    raise results["primary"][1]
+                cv.wait()
+
+    def infer(self, x) -> np.ndarray:
+        return self.fetch(self.dispatch(x))
+
+    # -- admin: drain / rejoin --------------------------------------------
+
+    def drain(self, rid: str) -> dict:
+        """Stop picking `rid`: no new dispatches, no rescue or hedge
+        targets land on it either (both go through the pick). Batches
+        it already holds finish normally — fetch doesn't pick — so the
+        window empties on its own. Refuses to drain the last active
+        replica: that is 'shut the service down', which has its own
+        signal."""
+        with self._cond:
+            rep = self._get(rid)
+            if rep.state != "draining":
+                others = [r for r in self.replicas
+                          if r.state == "active" and r.rid != rid]
+                if not others:
+                    raise RuntimeError(
+                        f"refusing to drain {rid}: it is the last active "
+                        "replica (SIGTERM the server to stop serving)")
+                rep.state = "draining"
+                self._cond.notify_all()
+            snap = self._replica_snapshot(rep)
+        log.info("fleet: replica %s draining (%d in flight)", rid,
+                 snap["inflight"])
+        return snap
+
+    def rejoin(self, rid: str) -> dict:
+        """Return a drained replica to the pick set with a FRESH health
+        slate (breaker window + cooldown + tracker cleared): the
+        operator asserting 'repaired' must not be vetoed by failures
+        recorded before the repair."""
+        with self._cond:
+            rep = self._get(rid)
+            rep.state = "active"
+            self._cond.notify_all()
+        self.breaker.reset(rid)
+        self.health.reset(rid)
+        log.info("fleet: replica %s rejoined", rid)
+        with self._cond:
+            return self._replica_snapshot(rep)
+
+    def _get(self, rid: str) -> _Replica:
+        rep = self._by_id.get(rid)
+        if rep is None:
+            raise KeyError(f"unknown replica {rid!r}; fleet has "
+                           f"{self.replica_ids()}")
+        return rep
+
+    # -- introspection -----------------------------------------------------
+
+    def _replica_snapshot(self, rep: _Replica) -> dict:
+        # caller holds self._cond
+        return {
+            "id": rep.rid,
+            "state": rep.state,
+            "healthy": not self.breaker.in_cooldown(rep.rid),
+            "health_score": round(self.health.score(rep.rid), 4),
+            "inflight": rep.inflight,
+            "outstanding_cost_ms": round(rep.outstanding_s * 1e3, 3),
+            "dispatched_batches": rep.dispatched_batches,
+            "dispatched_rows": rep.dispatched_rows,
+            "failures": rep.failures,
+        }
+
+    def snapshot(self) -> dict:
+        """The /healthz + /metrics fleet block: per-replica state and
+        the fleet-level failover/hedge counters."""
+        with self._cond:
+            replicas = [self._replica_snapshot(r) for r in self.replicas]
+            out = {
+                "n_replicas": len(self.replicas),
+                "per_replica_inflight": self.per_replica_inflight,
+                "hedge": self.hedge,
+                "replicas": replicas,
+                "failovers": {"dispatch": self._failovers_dispatch,
+                              "fetch": self._failovers_fetch},
+                "hedges": {"fired": self._hedges,
+                           "wins": self._hedge_wins},
+                "replica_trips": self._replica_trips,
+            }
+        out["breaker"] = self.breaker.snapshot()
+        out["health"] = self.health.snapshot()
+        return out

@@ -88,6 +88,16 @@ class ServeMetrics:
             self._breaker_trips = 0
             self._rollbacks = 0
             self._last_rollback = None       # {"from", "to", "at"}
+            # fleet accounting (ISSUE 6): per-replica batch populations
+            # (attribution rides the handle's replica tag, exactly like
+            # by_version), plus the failover/hedge counters — how often
+            # redundancy, not retry, absorbed a fault.
+            self._by_replica: dict[str, dict] = {}
+            self._failovers: dict[str, int] = {}   # kind -> count
+            self._last_failover = None     # {"kind", "from", "to", "at"}
+            self._hedges = 0
+            self._hedge_wins = 0
+            self._replica_trips: dict[str, int] = {}   # rid -> trips
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -126,7 +136,8 @@ class ServeMetrics:
             self._fetch_s.append(seconds)
 
     def record_batch(self, rows: int, bucket: int,
-                     queue_depth: int, version: str = None) -> None:
+                     queue_depth: int, version: str = None,
+                     replica: str = None) -> None:
         with self._lock:
             self._batches += 1
             occ = self._occupancy.setdefault(bucket, [0, 0])
@@ -138,6 +149,11 @@ class ServeMetrics:
             self._depth_max = max(self._depth_max, queue_depth)
             if version is not None:
                 self._version_stats(version)["batches"] += 1
+            if replica is not None:
+                s = self._by_replica.setdefault(
+                    replica, {"batches": 0, "rows": 0})
+                s["batches"] += 1
+                s["rows"] += rows
 
     def record_wait(self, seconds: float) -> None:
         """The effective coalescing wait the dispatch thread used for
@@ -234,6 +250,36 @@ class ServeMetrics:
                                    "to": to_version,
                                    "at": round(time.time(), 3)}
 
+    # -- fleet hooks (ISSUE 6) ---------------------------------------------
+
+    def record_failover(self, kind: str, from_replica: str,
+                        to_replica: str) -> None:
+        """One batch rescued on a sibling after its replica died at
+        `kind` ('dispatch' | 'fetch') — the fault cost latency, not an
+        error."""
+        with self._lock:
+            self._failovers[kind] = self._failovers.get(kind, 0) + 1
+            self._last_failover = {"kind": kind, "from": from_replica,
+                                   "to": to_replica,
+                                   "at": round(time.time(), 3)}
+
+    def record_hedge(self, win: bool) -> None:
+        """One hedged fetch resolved: win=True means the duplicate beat
+        the overdue primary (the hedge bought the tail back)."""
+        with self._lock:
+            self._hedges += 1
+            if win:
+                self._hedge_wins += 1
+
+    def record_replica_trip(self, replica: str) -> None:
+        """A replica's breaker tripped: it is excluded from dispatch
+        for its cooldown while siblings absorb its share. Keyed by
+        replica — after an incident, WHICH replica kept tripping is
+        the question."""
+        with self._lock:
+            self._replica_trips[replica] = (
+                self._replica_trips.get(replica, 0) + 1)
+
     # -- reporting ---------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -311,6 +357,17 @@ class ServeMetrics:
                     for pair, s in sorted(self._shadow.items())},
                 "shadow_errors": self._shadow_errors,
                 "shadow_dropped": self._shadow_dropped,
+                "by_replica": {r: dict(s) for r, s in
+                               sorted(self._by_replica.items())},
+                "fleet": {
+                    "failovers": dict(self._failovers),
+                    "failovers_total": sum(self._failovers.values()),
+                    "last_failover": self._last_failover,
+                    "hedges": self._hedges,
+                    "hedge_wins": self._hedge_wins,
+                    "replica_trips": sum(self._replica_trips.values()),
+                    "replica_trips_by_replica": dict(self._replica_trips),
+                },
                 "resilience": {
                     "deadline_shed_requests": self._deadline_shed_requests,
                     "deadline_shed_rows": self._deadline_shed_rows,

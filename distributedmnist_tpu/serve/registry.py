@@ -55,9 +55,14 @@ STATES = ("warming", "ready", "live", "failed")
 @dataclasses.dataclass
 class ModelVersion:
     version: str
-    engine: Any
+    engine: Any                    # replica 0's engine (None until warm)
     state: str
     source: str                    # "checkpoint <dir>" | "fresh-init" | ...
+    # One warmed engine per fleet replica (ISSUE 6), [engine] on a
+    # single-replica registry: promote/shadow/canary fan the whole list
+    # out so every replica rolls together. `engine` stays the first
+    # entry for the single-replica surface tests and describe() use.
+    engines: list = dataclasses.field(default_factory=list)
     step: Optional[int] = None     # checkpoint step, when from disk
     warmup_compile_events: int = 0
     warmup_s: float = 0.0
@@ -94,34 +99,84 @@ class ModelVersion:
                 str(b): round(c * 1e3, 3)
                 for b, c in sorted(self.engine.bucket_costs().items())}
                 if self.engine is not None else None),
+            # one warmed engine per fleet replica; 1 on a single-router
+            # registry, 0 while warming/failed
+            "replica_engines": len(self.engines),
         }
 
 
 class EngineFactory:
-    """Builds shape-identical InferenceEngines, one per model version.
+    """Builds shape-identical InferenceEngines, one per (model version,
+    fleet replica).
 
-    Owns the shared geometry (model, mesh, dtype, bucket ladder) so every
-    version compiles the same set of programs, and exposes the abstract
-    params tree (shapes/dtypes/replicated sharding) the params-only
-    checkpoint restore needs — computed via eval_shape, no device work."""
+    Owns the shared geometry (model, per-replica meshes, dtype, bucket
+    ladder) so every version compiles the same set of programs, and
+    exposes the abstract params tree (shapes/dtypes/replicated sharding)
+    the params-only checkpoint restore needs — computed via eval_shape,
+    no device work.
+
+    With `replicas` > 1 (ISSUE 6) the mesh's devices are cut into equal
+    slices, one per replica, when they divide evenly — each replica's
+    engines then run on disjoint chips (a real fault/perf isolation
+    domain). Hosts without enough devices (the 1-chip CPU bench host)
+    fall back to N LOGICAL replicas sharing the full mesh: separate
+    engines, separate staging pools, separate jitted programs — the
+    full dispatch/failover machinery exercised, minus the physical
+    isolation. `n_chips` / `mesh` / `buckets` are PER-REPLICA (the
+    bucket ladder must shard over one replica's data-parallel width);
+    `total_chips` is the whole fleet's denominator."""
 
     def __init__(self, model, mesh, dtype=None, max_batch: int = 512,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 replicas: int = 1):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.model = model
-        self.mesh = mesh
+        self.replicas = replicas
+        devices = list(mesh.devices.flat)
+        if replicas > 1 and len(devices) >= replicas \
+                and len(devices) % replicas == 0:
+            from distributedmnist_tpu.parallel import make_mesh
+
+            k = len(devices) // replicas
+            self.meshes = [make_mesh(devices[i * k:(i + 1) * k])
+                           for i in range(replicas)]
+        else:
+            self.meshes = [mesh] * replicas
+        self.mesh = self.meshes[0]
         self.dtype = dtype
         self.max_batch = max_batch
-        self.n_chips = int(np.prod(mesh.devices.shape))
+        self.n_chips = int(np.prod(self.mesh.devices.shape))
+        self.total_chips = len({d for m in self.meshes
+                                for d in m.devices.flat})
         self.platform = mesh.devices.flat[0].platform
         self.buckets = (tuple(sorted(set(buckets))) if buckets
                         else make_buckets(max_batch, self.n_chips))
 
-    def make_router(self, metrics=None, seed: int = 0) -> Router:
+    def make_router(self, metrics=None, seed: int = 0,
+                    replica: Optional[str] = None) -> Router:
         return Router(self.max_batch, self.buckets, self.platform,
-                      n_chips=self.n_chips, metrics=metrics, seed=seed)
+                      n_chips=self.n_chips, metrics=metrics, seed=seed,
+                      replica=replica)
 
-    def make_engine(self, params, version: str) -> InferenceEngine:
-        return InferenceEngine(self.model, params, self.mesh,
+    def make_fleet(self, metrics=None, seed: int = 0,
+                   per_replica_inflight: Optional[int] = None,
+                   hedge: bool = False):
+        """The N-replica dispatcher (serve/fleet.py): one Router per
+        replica, each labelled rN and seeded distinctly so canary/
+        shadow sampling never locksteps across replicas."""
+        from distributedmnist_tpu.serve.fleet import ReplicaSet
+
+        routers = [self.make_router(metrics=metrics, seed=seed + i,
+                                    replica=f"r{i}")
+                   for i in range(self.replicas)]
+        return ReplicaSet(routers, metrics=metrics,
+                          per_replica_inflight=per_replica_inflight,
+                          hedge=hedge)
+
+    def make_engine(self, params, version: str,
+                    replica: int = 0) -> InferenceEngine:
+        return InferenceEngine(self.model, params, self.meshes[replica],
                                dtype=self.dtype, max_batch=self.max_batch,
                                buckets=self.buckets, version=version)
 
@@ -180,6 +235,12 @@ class ModelRegistry:
         self.router = router
         self.checkpoint_dir = checkpoint_dir
         self.max_versions = max_versions
+        # Fleet-aware (ISSUE 6): a ReplicaSet router means every
+        # version warms ONE ENGINE PER REPLICA and every routing
+        # mutation fans the whole list out — a roll moves the entire
+        # fleet, never a subset. A plain Router keeps the 1-engine
+        # surface byte-for-byte.
+        self.n_replicas = getattr(router, "n_replicas", 1)
         self._versions: dict[str, ModelVersion] = {}   # insertion-ordered
         self._admin = threading.RLock()
         self._state = threading.Lock()
@@ -237,23 +298,38 @@ class ModelRegistry:
                 # warmup failure exercises the same failed-version
                 # bookkeeping a real compile/OOM failure would.
                 failpoint("registry.warmup", version=version)
-                engine = self.factory.make_engine(params, version)
-                mv.warmup_compile_events = engine.warmup()
-                # Clockwork bar: prove EVERY bucket is compiled by
-                # re-running warmup — a pure jit-cache pass costs zero
-                # compile events or this version must not take traffic.
-                residual = engine.warmup()
-                if residual:
-                    raise RuntimeError(
-                        f"version {version!r} still compiled {residual} "
-                        "time(s) on the verification warmup pass — "
-                        "refusing to mark it promotable")
-                mv.engine = engine
+                # One engine PER REPLICA (a single engine on a plain
+                # Router), each proved warm individually: a version is
+                # promotable only when EVERY replica can serve it with
+                # zero residual compiles — promote fans out fleet-wide,
+                # so one cold replica would poison the fleet's tail.
+                engines = []
+                compile_events = 0
+                for i in range(self.n_replicas):
+                    engine = self.factory.make_engine(params, version,
+                                                      replica=i)
+                    compile_events += engine.warmup()
+                    # Clockwork bar: prove EVERY bucket is compiled by
+                    # re-running warmup — a pure jit-cache pass costs
+                    # zero compile events or this version must not take
+                    # traffic.
+                    residual = engine.warmup()
+                    if residual:
+                        raise RuntimeError(
+                            f"version {version!r} (replica {i}) still "
+                            f"compiled {residual} time(s) on the "
+                            "verification warmup pass — refusing to "
+                            "mark it promotable")
+                    engines.append(engine)
+                mv.warmup_compile_events = compile_events
+                mv.engines = engines
+                mv.engine = engines[0]
                 mv.warmup_s = time.perf_counter() - t0
                 mv.state = "ready"
             except Exception as e:
                 mv.state = "failed"
                 mv.engine = None     # don't pin a half-warm engine's HBM
+                mv.engines = []
                 # Surfaced per-version in GET /models, not just logged:
                 # a failed load's WHY must outlive the admin request
                 # that triggered it (ISSUE 5 satellite).
@@ -366,6 +442,21 @@ class ModelRegistry:
 
     # -- routing -----------------------------------------------------------
 
+    def _route_set(self, kind: str, mv: ModelVersion,
+                   fraction: Optional[float] = None) -> None:
+        """One routing mutation, fanned out fleet-wide: a ReplicaSet
+        takes the whole per-replica engine list under its pick lock (no
+        batch dispatches mid-roll); a plain Router takes the single
+        engine — same call sites, no drift between the two shapes."""
+        target = (list(mv.engines) if self.n_replicas > 1
+                  else mv.engines[0])
+        if kind == "live":
+            self.router.set_live(target, mv.version)
+        elif kind == "shadow":
+            self.router.set_shadow(target, mv.version, fraction)
+        else:
+            self.router.set_canary(target, mv.version, fraction)
+
     def promote(self, version: str) -> ModelVersion:
         """Atomic hot-swap: `version` (which must be warmed: 'ready' or
         already 'live') becomes the live target. The demoted version
@@ -377,7 +468,7 @@ class ModelRegistry:
                     f"version {version!r} is {mv.state!r}; only a warmed "
                     "('ready') version may take live traffic")
             prev = self.router.live_version()
-            self.router.set_live(mv.engine, version)
+            self._route_set("live", mv)
             mv.state = "live"
             if prev is not None and prev != version:
                 old = self._versions.get(prev)
@@ -409,7 +500,7 @@ class ModelRegistry:
             candidates = [
                 mv for name, mv in self._versions.items()
                 if name != from_version and mv.state == "ready"
-                and mv.engine is not None and mv.last_error is None]
+                and mv.engines and mv.last_error is None]
             now = time.time()
             old = self._versions.get(from_version)
             if not candidates:
@@ -425,7 +516,7 @@ class ModelRegistry:
             # promote()'s core, inlined: _state is a plain Lock (not
             # re-entrant) and the demotion must also stamp last_error
             # atomically with the swap.
-            self.router.set_live(target.engine, target.version)
+            self._route_set("live", target)
             target.state = "live"
             if old is not None:
                 old.state = "ready"
@@ -454,7 +545,7 @@ class ModelRegistry:
                 raise RuntimeError(
                     f"version {version!r} is {mv.state!r}; only a warmed "
                     "non-live version can shadow")
-            self.router.set_shadow(mv.engine, version, fraction)
+            self._route_set("shadow", mv, fraction)
             return mv
 
     def set_canary(self, version: str, fraction: float = 0.1
@@ -467,7 +558,7 @@ class ModelRegistry:
                 raise RuntimeError(
                     f"version {version!r} is {mv.state!r}; only a warmed "
                     "non-live version can take canary traffic")
-            self.router.set_canary(mv.engine, version, fraction)
+            self._route_set("canary", mv, fraction)
             return mv
 
     def clear_candidates(self) -> None:
@@ -504,6 +595,7 @@ class ModelRegistry:
                 "checkpoint_dir": self.checkpoint_dir,
                 "buckets": list(self.factory.buckets),
                 "max_batch": self.factory.max_batch,
+                "replicas": self.n_replicas,
             }
 
     # -- eviction ----------------------------------------------------------
@@ -535,13 +627,25 @@ def build_serving(cfg, metrics=None):
     """(registry, router, factory) from a Config — the multi-version
     sibling of engine.build_engine. No version is loaded yet: callers
     decide boot order (serve.py bootstraps in a warm thread so /healthz
-    can report 'warming' while the HTTP server is already up)."""
+    can report 'warming' while the HTTP server is already up).
+
+    cfg.serve_replicas > 1 (ISSUE 6) returns a ReplicaSet in the router
+    slot — engine-shaped, so every downstream consumer (batcher,
+    serve.py, bench) is fleet-or-single agnostic; serve_replicas == 1
+    keeps the bare Router (a one-member fleet is pure overhead)."""
     from distributedmnist_tpu.serve.engine import build_model_and_mesh
 
     model, mesh, dtype = build_model_and_mesh(cfg)
     factory = EngineFactory(model, mesh, dtype=dtype,
-                            max_batch=cfg.serve_max_batch)
-    router = factory.make_router(metrics=metrics, seed=cfg.seed)
+                            max_batch=cfg.serve_max_batch,
+                            replicas=cfg.serve_replicas)
+    if cfg.serve_replicas > 1:
+        router = factory.make_fleet(
+            metrics=metrics, seed=cfg.seed,
+            per_replica_inflight=cfg.serve_replica_inflight,
+            hedge=cfg.serve_hedge)
+    else:
+        router = factory.make_router(metrics=metrics, seed=cfg.seed)
     registry = ModelRegistry(factory, router,
                              checkpoint_dir=cfg.checkpoint_dir,
                              max_versions=cfg.serve_max_versions)

@@ -32,6 +32,16 @@ the single owner of dispatch mechanics, the registry of version state:
   from the ModelRegistry (the PR 3 rollback path, now closed-loop),
   emitting a rollback event — a bad promote heals in one breaker
   window instead of waiting for a human on the admin API.
+
+- **HealthTracker** (ISSUE 6): the sliding-window health score behind
+  the replica fleet's dispatch pick (serve/fleet.py). The breaker
+  answers one binary question (exclude or not); the tracker keeps the
+  richer per-key signal — n-weighted success ratio plus a latency EWMA
+  — that /healthz, /metrics and the fleet's least-loaded pick surface.
+  A sick replica is a different diagnosis from a sick version: the
+  fleet keys its breaker and tracker by REPLICA id and routes around a
+  tripped replica, while the version breaker above keeps rolling bad
+  PROMOTES back — the two act on disjoint failure domains.
 """
 
 from __future__ import annotations
@@ -118,6 +128,25 @@ class CircuitBreaker:
         with self._lock:
             return self._trips
 
+    def in_cooldown(self, key: str, now: Optional[float] = None) -> bool:
+        """True while `key` (a version, or a replica id in the fleet's
+        per-replica breaker) is inside a trip's cooldown — the fleet's
+        dispatch pick excludes such replicas instead of waiting for
+        their failures to resolve futures."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            return now < self._cooldown_until.get(key, 0.0)
+
+    def reset(self, key: str) -> None:
+        """Forget `key`'s window AND cooldown: an operator rejoining a
+        drained/repaired replica gets a fresh health slate — old
+        failures from before the repair must not re-trip it on its
+        first post-rejoin batch."""
+        with self._lock:
+            self._windows.pop(key, None)
+            self._cooldown_until.pop(key, None)
+
     def snapshot(self) -> dict:
         with self._lock:
             now = time.monotonic()
@@ -134,6 +163,91 @@ class CircuitBreaker:
                             0.0), 3)}
                     for v, win in self._windows.items()},
             }
+
+
+class HealthTracker:
+    """Per-key sliding-window health score (ISSUE 6): n-weighted
+    success ratio over the last `window_s` seconds plus a latency EWMA.
+
+    The replica fleet records every batch outcome here (keyed by
+    replica id) alongside the per-replica CircuitBreaker: the breaker
+    decides EXCLUSION (binary, with cooldown hysteresis), the tracker
+    keeps the continuous score an operator reads off /healthz to see a
+    replica degrading BEFORE it trips. score() is 1.0 with no data —
+    an idle replica is presumed healthy, not suspect. Thread-safe.
+    """
+
+    def __init__(self, window_s: float = 30.0, ewma_alpha: float = 0.2):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        self.window_s = window_s
+        self.ewma_alpha = ewma_alpha
+        self._lock = threading.Lock()
+        self._windows: dict[str, deque] = {}   # key -> (t, ok, n)
+        self._ewma_s: dict[str, float] = {}
+
+    def record(self, key: str, ok: bool, n: int = 1,
+               latency_s: Optional[float] = None,
+               now: Optional[float] = None) -> None:
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            win = self._windows.setdefault(key, deque())
+            win.append((now, ok, n))
+            cutoff = now - self.window_s
+            while win and win[0][0] < cutoff:
+                win.popleft()
+            if latency_s is not None:
+                prev = self._ewma_s.get(key)
+                self._ewma_s[key] = (
+                    latency_s if prev is None
+                    else prev + self.ewma_alpha * (latency_s - prev))
+
+    def score(self, key: str, now: Optional[float] = None) -> float:
+        """Success ratio over the live window; 1.0 with no samples."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            win = self._windows.get(key)
+            if not win:
+                return 1.0
+            cutoff = now - self.window_s
+            total = ok = 0
+            for t, o, n in win:
+                if t < cutoff:
+                    continue
+                total += n
+                if o:
+                    ok += n
+            return ok / total if total else 1.0
+
+    def reset(self, key: str) -> None:
+        with self._lock:
+            self._windows.pop(key, None)
+            self._ewma_s.pop(key, None)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            out = {}
+            for key, win in self._windows.items():
+                cutoff = now - self.window_s
+                live = [(t, o, n) for t, o, n in win if t >= cutoff]
+                total = sum(n for _, _, n in live)
+                fails = sum(n for _, o, n in live if not o)
+                ewma = self._ewma_s.get(key)
+                out[key] = {
+                    "volume": total,
+                    "failures": fails,
+                    "success_ratio": (round((total - fails) / total, 4)
+                                      if total else None),
+                    "latency_ewma_ms": (round(ewma * 1e3, 3)
+                                        if ewma is not None else None),
+                }
+            return out
 
 
 class ResiliencePolicy:

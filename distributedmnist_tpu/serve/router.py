@@ -80,6 +80,11 @@ class RoutedHandle:
     shadow_handle: Any = None
     shadow_engine: Any = None
     shadow_version: Optional[str] = None
+    # The fleet replica this router belongs to (ISSUE 6): dispatch now
+    # targets (version, replica), and the tag rides the handle end to
+    # end so metrics can attribute each batch to the replica that
+    # COMPUTED it. None on a standalone (single-replica) router.
+    replica: Optional[str] = None
 
 
 class Router:
@@ -98,12 +103,17 @@ class Router:
 
     def __init__(self, max_batch: int, buckets: Sequence[int],
                  platform: str, n_chips: int = 1, metrics=None,
-                 seed: int = 0, shadow_cap: Optional[int] = None):
+                 seed: int = 0, shadow_cap: Optional[int] = None,
+                 replica: Optional[str] = None):
         self.max_batch = max_batch
         self.buckets = tuple(buckets)
         self.platform = platform
         self.n_chips = n_chips
         self.metrics = metrics
+        # The fleet replica id this router serves (None standalone):
+        # stamped onto every RoutedHandle so a batch is attributable to
+        # (version, replica) end to end.
+        self.replica = replica
         # `is None`, not `or`: an explicit 0 (duplicate nothing — every
         # sampled batch counts as dropped) must be honored.
         self.shadow_cap = (self.SHADOW_CAP if shadow_cap is None
@@ -224,6 +234,17 @@ class Router:
         # split", same as a pre-warmup engine
         return costs() if callable(costs) else {}
 
+    def bucket_costs_p95(self) -> dict:
+        """The live engine's p95 cost table (the fleet's hedge-trigger
+        basis); empty while no version is live or for engine-shaped
+        doubles without one — which disables hedging, not serving."""
+        with self._lock:
+            live = self._live
+        if live is None:
+            return {}
+        costs = getattr(live.engine, "bucket_costs_p95", None)
+        return costs() if callable(costs) else {}
+
     # -- the engine surface the batcher drives ----------------------------
 
     def dispatch(self, x) -> RoutedHandle:
@@ -240,7 +261,7 @@ class Router:
         h = target.engine.dispatch(x)
         rh = RoutedHandle(handle=h, engine=target.engine,
                           version=target.version, n=h.n, bucket=h.bucket,
-                          canary=is_canary)
+                          canary=is_canary, replica=self.replica)
         # Shadow only duplicates LIVE-routed batches: the canary and
         # shadow populations stay disjoint, so their metrics are
         # separately attributable.

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# The canonical tier-1 gate, verbatim from ROADMAP.md ("Tier-1
+# verify"). Builder, reviewer and CI all run THIS script instead of
+# each retyping the command — if the gate ever changes, change
+# ROADMAP.md and this file together (they must stay identical).
+#
+# Exit code is pytest's; DOTS_PASSED echoes the progress-dot count the
+# driver compares across rounds.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -41,6 +41,11 @@ Two modes:
                          "canary", "fraction"?: float} — atomic
                          hot-swap (live), or route a fraction as
                          shadow (compare + discard) / canary (real)
+    POST /replicas/{id}/drain    take one fleet replica out of the
+                         dispatch pick set (in-flight work finishes;
+                         version rolls still fan out to it)
+    POST /replicas/{id}/rejoin   return it with a fresh health slate
+                         (both 409 unless --serve-replicas >= 2)
 
 SIGHUP = load latest checkpoint from --checkpoint-dir and promote it
 (the operator's one-signal model roll). The server starts serving HTTP
@@ -75,6 +80,18 @@ live version and auto-promotes the newest healthy resident, emitting a
 rollback event visible in /healthz and GET /models. --serve-faults
 installs a deterministic fault-injection schedule (serve/faults.py) for
 chaos drills; without it every woven failpoint is inert.
+
+Replica fleet (ISSUE 6, serve/fleet.py): --serve-replicas N puts N
+engine replicas (mesh slices when devices divide evenly, logical
+replicas otherwise) behind a health-tracked load-balancing dispatcher
+with per-replica in-flight windows (--serve-replica-inflight), failover
+redispatch (a batch whose replica dies at dispatch/fetch retries once
+on a healthy sibling — replica faults cost latency, not errors), an
+optional hedged-tail duplicate (--serve-hedge), and per-replica circuit
+breakers that route around a sick replica without touching the version.
+/healthz and /metrics carry the per-replica state; every shed response's
+Retry-After is capped at --serve-retry-after-cap-s (integer seconds per
+RFC 9110).
 """
 
 from __future__ import annotations
@@ -106,6 +123,11 @@ class ServerState:
     def __init__(self):
         self._lock = threading.Lock()
         self.phase = "warming"
+        # Process start, wall clock: /healthz reports it (ISO 8601) plus
+        # a derived uptime so fleet-level probes and the bench ledger
+        # can tell a RESTARTED worker (uptime reset) from a RECOVERED
+        # one (uptime continuous across the unhealthy window).
+        self.started_at = time.time()
 
     def mark_running(self) -> None:
         """warming/failed -> running (no-op from draining)."""
@@ -151,9 +173,14 @@ class ServerState:
         with self._lock:
             phase = self.phase
         ok = phase == "running" and live is not None
+        import datetime
         payload = {
             "ok": ok,
             "state": phase,
+            "started_at": datetime.datetime.fromtimestamp(
+                self.started_at,
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "uptime_s": round(time.time() - self.started_at, 3),
             "live_version": live,
             "pending_rows": batcher.pending_rows(),
             "inflight_batches": batcher.inflight_batches(),
@@ -161,7 +188,41 @@ class ServerState:
             "rollbacks": len(rollbacks),
             "last_rollback": attempts[-1] if attempts else None,
         }
+        # Replica fleet state (ISSUE 6): per-replica health/load plus
+        # the failover/hedge counters — the first thing to read after
+        # an availability dip is WHICH replica was sick and whether the
+        # fleet routed around it.
+        fleet = registry.router if hasattr(registry, "router") else None
+        if getattr(fleet, "n_replicas", 1) > 1:
+            snap = fleet.snapshot()
+            payload["replicas"] = snap["replicas"]
+            payload["failovers"] = snap["failovers"]
         return (200 if ok else 503), payload
+
+
+def shed_retry_after_s(batcher, cap_s: float = 30.0) -> int:
+    """The Retry-After value for every shed response (watermark 503,
+    no-live-model 503, deadline 504), derived from live pipeline state
+    instead of a hardcoded guess: the current effective coalescing wait
+    (where the adaptive controller actually sits, not the configured
+    cap) plus the in-flight depth priced at the measured full-batch
+    service time — roughly when the pipeline will have worked off what
+    it already holds. Emitted as INTEGER seconds per RFC 9110 (the
+    delay-seconds grammar has no fractions), floored at 1 and capped at
+    `cap_s` (serve_retry_after_cap_s): the derived value is unbounded
+    when the window is deep and a measured batch cost spikes, and a
+    client told to come back in ten minutes simply leaves."""
+    import math
+
+    wait_s = (batcher.controller.effective_wait_s()
+              if batcher.controller is not None
+              else batcher.max_wait_s)
+    costs_fn = getattr(batcher.engine, "bucket_costs", None)
+    costs = costs_fn() if callable(costs_fn) else {}
+    svc_s = max(costs.values()) if costs else 0.0
+    depth = batcher.inflight_batches()
+    cap = max(1, int(cap_s))
+    return max(1, min(cap, math.ceil(wait_s + (depth + 1) * svc_s)))
 
 
 def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
@@ -189,7 +250,7 @@ def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
 
 def _http_serve(batcher, metrics, registry, state, port: int,
                 metrics_every: float, request_timeout: float,
-                warm) -> dict:
+                warm, retry_after_cap_s: float = 30.0) -> dict:
     import concurrent.futures
     import math
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -198,25 +259,14 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                                             Rejected)
 
     max_body = registry.factory.max_batch * IMAGE_BYTES
+    # The replica fleet, when serving one (--serve-replicas >= 2):
+    # admin drain/rejoin and the /metrics fleet block hang off it.
+    fleet = (registry.router
+             if getattr(registry.router, "n_replicas", 1) > 1 else None)
 
     def retry_after() -> dict:
-        """The Retry-After header for every shed response (watermark
-        503, no-live-model 503, deadline 504), derived from live
-        pipeline state instead of a hardcoded guess: the current
-        effective coalescing wait (where the adaptive controller
-        actually sits, not the configured cap) plus the in-flight depth
-        priced at the measured full-batch service time — roughly when
-        the pipeline will have worked off what it already holds. Floors
-        at 1s (the header is integer seconds)."""
-        wait_s = (batcher.controller.effective_wait_s()
-                  if batcher.controller is not None
-                  else batcher.max_wait_s)
-        costs_fn = getattr(batcher.engine, "bucket_costs", None)
-        costs = costs_fn() if callable(costs_fn) else {}
-        svc_s = max(costs.values()) if costs else 0.0
-        depth = batcher.inflight_batches()
-        return {"Retry-After": str(max(1, math.ceil(
-            wait_s + (depth + 1) * svc_s)))}
+        return {"Retry-After": str(
+            shed_retry_after_s(batcher, retry_after_cap_s))}
     # Serializes admin mutations from HTTP/SIGHUP threads so two
     # concurrent loads can't interleave their registry side effects
     # mid-request (the registry's own lock already protects state; this
@@ -277,6 +327,10 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 payload["resilience_policy"] = (
                     batcher.resilience.snapshot()
                     if batcher.resilience is not None else None)
+                # the fleet's per-replica load/health + failover and
+                # hedge counters (None on a single-replica server)
+                payload["fleet"] = (fleet.snapshot()
+                                    if fleet is not None else None)
                 self._send(200, payload)
             elif self.path == "/models":
                 self._send(200, registry.describe())
@@ -290,8 +344,45 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 self._models_load()
             elif self.path == "/models/promote":
                 self._models_promote()
+            elif self.path.startswith("/replicas/"):
+                self._replicas_admin()
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
+
+        # -- admin: replica fleet ---------------------------------------
+
+        def _replicas_admin(self):
+            """POST /replicas/{id}/drain|rejoin — take one replica out
+            of the dispatch pick set (in-flight work finishes; a
+            version roll still fans out to it so rejoin never serves a
+            stale version) or bring it back with a fresh health slate.
+            409 on a single-replica server (there is no fleet to
+            administer) and on draining the last active replica."""
+            parts = self.path.strip("/").split("/")
+            if len(parts) != 3 or parts[2] not in ("drain", "rejoin"):
+                self._send(404, {"error": "want POST /replicas/{id}/"
+                                          "drain or /replicas/{id}/"
+                                          "rejoin"})
+                return
+            _, rid, action = parts
+            if fleet is None:
+                self._send(409, {"error": "this server runs a single "
+                                          "replica; --serve-replicas "
+                                          ">= 2 enables the fleet"})
+                return
+            try:
+                with admin_lock:
+                    snap = (fleet.drain(rid) if action == "drain"
+                            else fleet.rejoin(rid))
+                self._send(200, {"action": action, "replica": snap})
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+            except RuntimeError as e:
+                # e.g. draining the last active replica: a rule
+                # refusal, not a server fault
+                self._send(409, {"error": str(e)})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
         # -- admin: model lifecycle -----------------------------------
 
@@ -560,6 +651,14 @@ def main(argv=None) -> int:
     if (args.serve_breaker_ratio is not None
             and not 0 < args.serve_breaker_ratio <= 1):
         p.error("--serve-breaker-ratio must be in (0, 1]")
+    if args.serve_replicas is not None and args.serve_replicas < 1:
+        p.error("--serve-replicas must be >= 1")
+    if (args.serve_replica_inflight is not None
+            and args.serve_replica_inflight < 1):
+        p.error("--serve-replica-inflight must be >= 1")
+    if (args.serve_retry_after_cap_s is not None
+            and args.serve_retry_after_cap_s < 1):
+        p.error("--serve-retry-after-cap-s must be >= 1")
     if args.serve_faults is not None:
         # a malformed chaos schedule is a usage error NOW — it must
         # never boot a server that silently injects nothing
@@ -615,7 +714,9 @@ def main(argv=None) -> int:
         else:
             summary = _http_serve(batcher, metrics, registry, state,
                                   args.port, args.metrics_every,
-                                  args.request_timeout, warm)
+                                  args.request_timeout, warm,
+                                  retry_after_cap_s=(
+                                      cfg.serve_retry_after_cap_s))
     finally:
         batcher.stop()
     print(json.dumps(summary), flush=True)

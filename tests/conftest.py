@@ -33,6 +33,11 @@ def pytest_configure(config):
         "chaos: deterministic fault-injection test (serve/faults.py "
         "schedules with fixed seeds; cheap and replayable, so chaos "
         "tests run in tier-1 — `-m 'not slow'` keeps them)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: replica-fleet test (serve/fleet.py: health-tracked "
+        "dispatch, failover, hedging, drain/rejoin); runs in tier-1 "
+        "like chaos — the marker exists for `-m fleet` selection")
 
 
 def committed_steps(ckpt_dir: str) -> list:

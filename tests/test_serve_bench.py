@@ -674,3 +674,37 @@ def test_bench_serve_swap_during_load():
     # is reported alongside the whole-window ratio
     assert swap["post_swap_p99_ms"] is not None
     assert swap["post_swap_p99_ratio_vs_steady"] is not None
+
+
+def test_baseline_delta_includes_chaos_leg_rows():
+    """ISSUE 6 satellite: the --baseline delta table carries the
+    chaos-leg resilience signals (availability, failovers,
+    p99-under-faults) alongside the happy-path columns — and degrades
+    to None-vs-None rows when either round ran without --chaos."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(worker_env()[1], "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+
+    def rec(value, chaos):
+        return {"value": value, "detail": {
+            "closed_loop": {"latency_ms": {"p99": 5.0}},
+            "ragged": None,
+            "recompiles_after_warmup": 0,
+            "chaos": chaos,
+            "host": {"device_kind": "cpu"}}}
+
+    cur = rec(100.0, {"availability_excluding_injected": 1.0,
+                      "p99_under_faults_ms": 40.0, "failovers": 29})
+    base = rec(90.0, {"availability_excluding_injected": 0.995,
+                      "p99_under_faults_ms": 50.0, "failovers": 0})
+    delta = bench_mod._baseline_delta(cur, base, "BENCH_serve_r04.json")
+    assert delta["chaos_availability"]["current"] == 1.0
+    assert delta["chaos_availability"]["baseline"] == 0.995
+    assert delta["chaos_p99_under_faults_ms"]["delta_pct"] == -20.0
+    assert delta["chaos_failovers"]["current"] == 29
+    # a chaos-less round degrades to empty rows, not a KeyError
+    delta = bench_mod._baseline_delta(rec(100.0, None), base, "x.json")
+    assert delta["chaos_availability"]["current"] is None

@@ -1,0 +1,694 @@
+"""serve/fleet.py: the replica fleet's dispatch pick (cost-aware,
+health-tracked, per-replica bounded windows), failover redispatch at
+dispatch AND fetch, hedged tails, breaker exclusion + limp mode,
+drain/rejoin admin, the registry's fleet-wide fan-out with real
+engines, and the serve.py wiring (auto window sizing, per-replica
+metrics attribution, Retry-After cap)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import faults
+from distributedmnist_tpu.serve.fleet import (FleetHandle,
+                                              NoReplicaAvailable,
+                                              ReplicaSet)
+from distributedmnist_tpu.serve.resilience import HealthTracker
+from distributedmnist_tpu.serve.router import NoLiveModel
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+class StubRouter:
+    """Router-shaped replica double: fetch() returns each row's first
+    10 pixels so results identify their input rows exactly (the proof
+    a failover rescue served the ORIGINAL payload). Failure switches
+    and a fetch gate make death and slowness deterministic."""
+
+    platform = "cpu"
+    n_chips = 1
+
+    def __init__(self, rid, costs=True):
+        self.replica = rid
+        self.max_batch = 16
+        self.buckets = (4, 8, 16)
+        self._costs = ({4: 1e-3, 8: 2e-3, 16: 4e-3} if costs else {})
+        self.fail_dispatch = False
+        self.fail_fetch = False
+        self.gate = None              # Event: fetch blocks until set
+        self.dispatches = 0
+        self.fetches = 0
+        self.live = "v1"
+
+    def bucket_costs(self):
+        return dict(self._costs)
+
+    def bucket_costs_p95(self):
+        return {b: 1.5 * c for b, c in self._costs.items()}
+
+    def live_version(self):
+        return self.live
+
+    def dispatch(self, x):
+        if self.fail_dispatch:
+            raise RuntimeError(f"{self.replica} dead at dispatch")
+        parts = x if isinstance(x, (list, tuple)) else [x]
+        flat = np.concatenate([np.asarray(p).reshape(p.shape[0], -1)
+                               for p in parts])
+        self.dispatches += 1
+        bucket = next(b for b in self.buckets if b >= flat.shape[0])
+        return SimpleNamespace(version=self.live, n=flat.shape[0],
+                               bucket=bucket, flat=flat)
+
+    def fetch(self, rh):
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30)
+        if self.fail_fetch:
+            raise RuntimeError(f"{self.replica} dead at fetch")
+        self.fetches += 1
+        return rh.flat[:, :10].astype(np.float32)
+
+
+def _fleet(n=2, costs=True, **kw):
+    routers = [StubRouter(f"r{i}", costs=costs) for i in range(n)]
+    kw.setdefault("per_replica_inflight", 2)
+    return ReplicaSet(routers, **kw), routers
+
+
+def _req(rng, n=4):
+    return rng.integers(0, 256, (n, 28, 28, 1)).astype(np.uint8)
+
+
+def test_fleet_rejects_degenerate_configs():
+    with pytest.raises(ValueError, match=">= 2 replicas"):
+        ReplicaSet([StubRouter("r0")])
+    bad = StubRouter("r1")
+    bad.buckets = (2, 4)
+    with pytest.raises(ValueError, match="geometry"):
+        ReplicaSet([StubRouter("r0"), bad])
+
+
+def test_engine_shape_and_window_total():
+    fleet, _ = _fleet(n=3, per_replica_inflight=2)
+    assert fleet.max_batch == 16 and fleet.buckets == (4, 8, 16)
+    assert fleet.platform == "cpu"
+    assert fleet.n_replicas == 3
+    assert fleet.max_inflight_total == 6
+    assert fleet.bucket_for(5) == 8
+    assert fleet.bucket_costs() == {4: 1e-3, 8: 2e-3, 16: 4e-3}
+
+
+def test_dispatch_balances_across_replicas(rng):
+    """With symmetric replicas the cost-aware pick degrades to
+    round-robin (dispatched_batches tiebreak): a synchronous
+    dispatch-fetch loop must split the load within one batch."""
+    fleet, routers = _fleet(n=2)
+    for _ in range(10):
+        out = fleet.infer(_req(rng))
+        assert out.shape == (4, 10)
+    counts = [r.dispatches for r in routers]
+    assert abs(counts[0] - counts[1]) <= 1, counts
+
+
+def test_pick_prefers_cheapest_outstanding_backlog(rng):
+    """A replica holding reserved work is priced by the bucket cost
+    table: the next dispatch goes to the idle sibling."""
+    fleet, routers = _fleet(n=2)
+    h1 = fleet.dispatch(_req(rng))          # lands on one replica
+    h2 = fleet.dispatch(_req(rng))          # must land on the other
+    assert {h1.replica, h2.replica} == {"r0", "r1"}
+    fleet.fetch(h1)
+    fleet.fetch(h2)
+
+
+def test_per_replica_window_bounds_and_blocks(rng):
+    """per_replica_inflight=1 x 2 replicas: the third dispatch blocks
+    until a fetch frees a slot — the fleet's own window, independent
+    of the batcher's semaphore."""
+    fleet, routers = _fleet(n=2, per_replica_inflight=1)
+    h1 = fleet.dispatch(_req(rng))
+    h2 = fleet.dispatch(_req(rng))
+    got = []
+    t = threading.Thread(target=lambda: got.append(
+        fleet.dispatch(_req(rng))), daemon=True)
+    t.start()
+    t.join(timeout=0.3)
+    assert t.is_alive(), "third dispatch should block at full windows"
+    fleet.fetch(h1)
+    t.join(timeout=5)
+    assert not t.is_alive() and got
+    fleet.fetch(h2)
+    fleet.fetch(got[0])
+    snap = fleet.snapshot()
+    assert all(r["inflight"] == 0 for r in snap["replicas"])
+
+
+def test_failover_at_dispatch_rescues_batch(rng):
+    fleet, routers = _fleet(n=2)
+    routers[0].fail_dispatch = routers[1].fail_dispatch = False
+    # force the first pick onto r0 by loading r1 with outstanding work
+    hb = fleet.dispatch(_req(rng))
+    victim = [r for r in routers if r.replica != hb.replica][0]
+    victim.fail_dispatch = True
+    x = _req(rng)
+    h = fleet.dispatch(x)                   # picked victim, rescued
+    assert h.replica == hb.replica
+    out = fleet.fetch(h)
+    np.testing.assert_array_equal(
+        out, x.reshape(4, -1)[:, :10].astype(np.float32))
+    fleet.fetch(hb)
+    snap = fleet.snapshot()
+    assert snap["failovers"]["dispatch"] == 1
+    assert snap["health"][victim.replica]["failures"] == 1
+
+
+def test_failover_at_fetch_redispatches_payload(rng):
+    """The fetch-side death: the handle's retained payload re-runs on
+    the sibling and the result still matches the ORIGINAL rows; the
+    handle re-tags to the computing replica."""
+    fleet, routers = _fleet(n=2)
+    x = _req(rng, 6)
+    h = fleet.dispatch(x)
+    victim = next(r for r in routers if r.replica == h.replica)
+    sibling = next(r for r in routers if r.replica != h.replica)
+    victim.fail_fetch = True
+    out = fleet.fetch(h)
+    np.testing.assert_array_equal(
+        out, x.reshape(6, -1)[:, :10].astype(np.float32))
+    assert h.replica == sibling.replica       # re-tagged
+    snap = fleet.snapshot()
+    assert snap["failovers"]["fetch"] == 1
+    assert all(r["inflight"] == 0 for r in snap["replicas"])
+
+
+def test_failover_gives_up_without_sibling_and_systemic_503(rng):
+    fleet, routers = _fleet(n=2)
+    # no healthy sibling: both dead at dispatch -> the error propagates
+    routers[0].fail_dispatch = routers[1].fail_dispatch = True
+    with pytest.raises(RuntimeError, match="dead at dispatch"):
+        fleet.dispatch(_req(rng))
+    routers[0].fail_dispatch = routers[1].fail_dispatch = False
+
+    # systemic 503 (no live model) must NOT failover or blame a replica
+    def no_live(x):
+        raise NoLiveModel("warming")
+
+    before = fleet.snapshot()
+    routers[0].dispatch = routers[1].dispatch = no_live
+    with pytest.raises(NoLiveModel):
+        fleet.dispatch(_req(rng))
+    snap = fleet.snapshot()
+    assert snap["failovers"] == before["failovers"]
+    # the systemic shed added no failures beyond the real ones above
+    assert sum(r["failures"] for r in snap["replicas"]) \
+        == sum(r["failures"] for r in before["replicas"])
+
+
+@pytest.mark.chaos
+def test_injected_replica_kill_is_rescued_end_to_end(rng):
+    """The chaos-bench storm in miniature: a replica.fetch rule pinned
+    to one replica kills its batches; every one must be rescued on the
+    sibling (futures resolve OK, failovers counted, nothing surfaces)."""
+    fleet, routers = _fleet(n=2)
+    faults.install(faults.FaultInjector.from_spec(
+        "replica.fetch:p=1,replica=r1,count=3", seed=5))
+    for _ in range(8):
+        out = fleet.infer(_req(rng))
+        assert out.shape == (4, 10)
+    snap = fleet.snapshot()
+    assert snap["failovers"]["fetch"] == 3
+    assert snap["replicas"][1]["failures"] == 3
+
+
+def test_breaker_trip_excludes_replica_then_limp_mode(rng):
+    fleet, routers = _fleet(n=2)
+    # trip r1: feed it failures directly through the recording path
+    r1 = fleet.replicas[1]
+    for _ in range(10):
+        fleet._record(r1, ok=False)
+    assert fleet.breaker.in_cooldown("r1")
+    snap = fleet.snapshot()
+    assert snap["replica_trips"] == 1
+    assert snap["replicas"][1]["healthy"] is False
+    d0 = routers[0].dispatches
+    for _ in range(4):
+        fleet.infer(_req(rng))
+    assert routers[0].dispatches == d0 + 4      # r1 never picked
+    assert routers[1].dispatches == 0
+    # now trip r0 too: limp mode keeps serving on least-loaded anyway
+    for _ in range(10):
+        fleet._record(fleet.replicas[0], ok=False)
+    assert fleet.breaker.in_cooldown("r0")
+    out = fleet.infer(_req(rng))
+    assert out.shape == (4, 10)
+
+
+def test_drain_rejoin_and_last_active_refusal(rng):
+    fleet, routers = _fleet(n=2)
+    snap = fleet.drain("r1")
+    assert snap["state"] == "draining"
+    with pytest.raises(RuntimeError, match="last active"):
+        fleet.drain("r0")
+    with pytest.raises(KeyError, match="unknown replica"):
+        fleet.drain("r9")
+    for _ in range(4):
+        fleet.infer(_req(rng))
+    assert routers[1].dispatches == 0           # drained: no new picks
+    # rejoin wipes the health slate (pre-repair failures must not
+    # re-trip the replica on its first post-rejoin batch)
+    for _ in range(10):
+        fleet._record(fleet.replicas[1], ok=False)
+    assert fleet.breaker.in_cooldown("r1")
+    snap = fleet.rejoin("r1")
+    assert snap["state"] == "active" and snap["healthy"] is True
+    assert not fleet.breaker.in_cooldown("r1")
+    fleet.infer(_req(rng))
+    assert routers[1].dispatches >= 1
+
+
+def test_draining_replica_still_fetches_inflight(rng):
+    """Drain during in-flight: the batch already on the draining
+    replica fetches normally — only NEW picks are excluded."""
+    fleet, routers = _fleet(n=2)
+    x = _req(rng)
+    h = fleet.dispatch(x)
+    fleet.drain(h.replica)
+    out = fleet.fetch(h)
+    np.testing.assert_array_equal(
+        out, x.reshape(4, -1)[:, :10].astype(np.float32))
+
+
+def test_all_replicas_draining_is_systemic_503(rng):
+    """White-box: the admin API refuses to empty the fleet, but if
+    every replica is nevertheless draining (future autoscaler paths),
+    dispatch sheds with 503 semantics — systemic, never bisected."""
+    fleet, _ = _fleet(n=2)
+    for rep in fleet.replicas:
+        rep.state = "draining"
+    with pytest.raises(NoReplicaAvailable) as ei:
+        fleet.dispatch(_req(np.random.default_rng(0)))
+    assert ei.value.status == 503
+
+
+def test_hedge_races_overdue_batch_and_duplicate_wins(rng):
+    """A batch past hedge_factor x p95(bucket) at fetch time races a
+    duplicate on the free sibling; with the primary gated shut the
+    duplicate must win, re-tagging the handle. The gated primary then
+    finishes in the background without corrupting the accounting."""
+    fleet, routers = _fleet(n=2, hedge=True, hedge_factor=1.0)
+    gate = threading.Event()
+    x = _req(rng, 5)
+    h = fleet.dispatch(x)
+    primary = next(r for r in routers if r.replica == h.replica)
+    sibling = next(r for r in routers if r.replica != h.replica)
+    primary.gate = gate
+    # p95 for bucket 8 is 3ms; hedge_factor 1.0 -> threshold 3ms
+    time.sleep(0.02)
+    out = fleet.fetch(h)
+    np.testing.assert_array_equal(
+        out, x.reshape(5, -1)[:, :10].astype(np.float32))
+    assert h.replica == sibling.replica
+    snap = fleet.snapshot()
+    assert snap["hedges"] == {"fired": 1, "wins": 1}
+    gate.set()                       # let the loser finish
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if all(r["inflight"] == 0
+               for r in fleet.snapshot()["replicas"]):
+            break
+        time.sleep(0.01)
+    assert all(r["inflight"] == 0 for r in fleet.snapshot()["replicas"])
+
+
+def test_hedge_not_fired_inside_threshold(rng):
+    fleet, routers = _fleet(n=2, hedge=True, hedge_factor=1000.0)
+    h = fleet.dispatch(_req(rng))
+    fleet.fetch(h)
+    assert fleet.snapshot()["hedges"]["fired"] == 0
+
+
+def test_hedge_never_targets_a_tripped_sibling(rng):
+    """A duplicate on a breaker-tripped replica is guaranteed wasted
+    work: with the only sibling in cooldown, an overdue batch fetches
+    plain — no hedge fires (unlike rescues, which may limp)."""
+    fleet, routers = _fleet(n=2, hedge=True, hedge_factor=1.0)
+    h = fleet.dispatch(_req(rng))
+    sibling = next(rep for rep in fleet.replicas
+                   if rep.rid != h.replica)
+    for _ in range(10):
+        fleet._record(sibling, ok=False)
+    assert fleet.breaker.in_cooldown(sibling.rid)
+    time.sleep(0.02)                 # past the 3ms bucket-8 threshold
+    out = fleet.fetch(h)
+    assert out.shape == (4, 10)
+    assert fleet.snapshot()["hedges"]["fired"] == 0
+
+
+def test_failover_counts_only_landed_rescues(rng):
+    """A rescue that fails the same way the primary did (a fault
+    present on EVERY replica, e.g. version-pinned) saved nothing and
+    must not count as a failover — the counter's contract is 'batches
+    redundancy saved'."""
+    fleet, routers = _fleet(n=2)
+    x = _req(rng)
+    h = fleet.dispatch(x)
+    routers[0].fail_fetch = routers[1].fail_fetch = True
+    with pytest.raises(RuntimeError, match="dead at fetch"):
+        fleet.fetch(h)
+    snap = fleet.snapshot()
+    assert snap["failovers"] == {"dispatch": 0, "fetch": 0}
+    assert all(r["inflight"] == 0 for r in snap["replicas"])
+
+
+def test_promote_fanout_requires_full_engine_list():
+    fleet, _ = _fleet(n=2)
+    with pytest.raises(ValueError, match="one engine per replica"):
+        fleet.set_live([object()], "v2")
+
+
+def test_health_tracker_window_and_reset():
+    t = HealthTracker(window_s=0.2)
+    assert t.score("r0") == 1.0
+    t.record("r0", ok=False, n=3, latency_s=0.01)
+    t.record("r0", ok=True, n=1)
+    assert t.score("r0") == pytest.approx(0.25)
+    snap = t.snapshot()["r0"]
+    assert snap["volume"] == 4 and snap["failures"] == 3
+    assert snap["latency_ewma_ms"] == pytest.approx(10.0)
+    time.sleep(0.25)
+    assert t.score("r0") == 1.0          # window slid past the failures
+    t.record("r0", ok=False)
+    t.reset("r0")
+    assert t.score("r0") == 1.0
+    with pytest.raises(ValueError):
+        HealthTracker(window_s=0)
+
+
+# -- batcher + metrics integration ----------------------------------------
+
+
+def test_batcher_auto_window_opens_to_fleet_total(rng):
+    from distributedmnist_tpu.serve import DynamicBatcher
+
+    fleet, _ = _fleet(n=3, per_replica_inflight=2)
+    b = DynamicBatcher(fleet, max_wait_us=100)
+    assert b.max_inflight == 6
+    # an explicit value still wins (the bench's pinned phases)
+    b2 = DynamicBatcher(fleet, max_wait_us=100, max_inflight=1)
+    assert b2.max_inflight == 1
+
+
+def test_batcher_attributes_batches_per_replica(rng):
+    from distributedmnist_tpu.serve import DynamicBatcher, ServeMetrics
+
+    fleet, routers = _fleet(n=2)
+    metrics = ServeMetrics()
+    b = DynamicBatcher(fleet, max_wait_us=100, metrics=metrics).start()
+    try:
+        futs = [b.submit(_req(rng, 2)) for _ in range(12)]
+        for f in futs:
+            assert f.result(timeout=30).shape == (2, 10)
+    finally:
+        b.stop()
+    by_replica = metrics.snapshot()["by_replica"]
+    assert set(by_replica) == {"r0", "r1"}
+    assert sum(s["rows"] for s in by_replica.values()) == 24
+
+
+@pytest.mark.chaos
+def test_batcher_failover_is_invisible_to_clients(rng):
+    """Through the full batcher: a replica-pinned kill storm costs
+    clients nothing — every future resolves, failovers show up only in
+    the metrics, and attribution names the RESCUING replica."""
+    from distributedmnist_tpu.serve import DynamicBatcher, ServeMetrics
+
+    metrics = ServeMetrics()
+    fleet, routers = _fleet(n=2, metrics=metrics)
+    faults.install(faults.FaultInjector.from_spec(
+        "replica.dispatch:p=1,replica=r0,count=2;"
+        "replica.fetch:p=1,replica=r0,after=2,count=2", seed=11))
+    # max_batch=2: one request per dispatch, so the storm's after/count
+    # windows land on a predictable per-replica batch sequence instead
+    # of being swallowed by coalescing
+    b = DynamicBatcher(fleet, max_batch=2, max_wait_us=100,
+                       metrics=metrics).start()
+    try:
+        futs = [b.submit(_req(rng, 2)) for _ in range(16)]
+        for f in futs:
+            assert f.result(timeout=30).shape == (2, 10)
+    finally:
+        b.stop()
+    snap = metrics.snapshot()
+    assert snap["fleet"]["failovers_total"] == 4
+    assert snap["fleet"]["failovers"] == {"dispatch": 2, "fetch": 2}
+    assert snap["fleet"]["last_failover"]["to"] == "r1"
+
+
+# -- registry fan-out with real engines (the zero-recompile contract) -----
+
+
+@pytest.fixture()
+def fleet_factory(eight_devices):
+    from distributedmnist_tpu import models
+    from distributedmnist_tpu.parallel import make_mesh
+    from distributedmnist_tpu.serve import EngineFactory
+
+    mesh = make_mesh(eight_devices)
+    model = models.build("mlp", platform="cpu")
+    return EngineFactory(model, mesh, max_batch=16, replicas=2)
+
+
+def test_factory_slices_mesh_into_disjoint_replicas(fleet_factory):
+    assert len(fleet_factory.meshes) == 2
+    ids = [set(d.id for d in m.devices.flat)
+           for m in fleet_factory.meshes]
+    assert ids[0].isdisjoint(ids[1])
+    assert fleet_factory.n_chips == 4
+    assert fleet_factory.total_chips == 8
+    # buckets shard over one REPLICA's data-parallel width
+    assert all(b % 4 == 0 for b in fleet_factory.buckets)
+
+
+def test_registry_fans_warm_and_promote_fleet_wide(fleet_factory, rng):
+    from distributedmnist_tpu.serve import ModelRegistry
+    from distributedmnist_tpu.utils import CompileCounter
+
+    fleet = fleet_factory.make_fleet()
+    registry = ModelRegistry(fleet_factory, fleet)
+    mv = registry.add(fleet_factory.init_params(0), version="v1")
+    assert len(mv.engines) == 2
+    assert mv.describe()["replica_engines"] == 2
+    registry.promote("v1")
+    assert all(rep.router.live_version() == "v1"
+               for rep in fleet.replicas)
+    compiles = CompileCounter.instance()
+    c0 = compiles.snapshot()
+    for _ in range(6):
+        assert fleet.infer(rng.integers(
+            0, 256, (5, 784)).astype(np.uint8)).shape == (5, 10)
+    assert compiles.snapshot() - c0 == 0, (
+        "steady-state fleet dispatch recompiled")
+    # a roll moves the WHOLE fleet
+    registry.add(fleet_factory.init_params(1), version="v2")
+    registry.promote("v2")
+    assert all(rep.router.live_version() == "v2"
+               for rep in fleet.replicas)
+    assert registry.describe()["replicas"] == 2
+    # drained replica still receives the roll (rejoin can't serve stale)
+    fleet.drain("r1")
+    registry.promote("v1")
+    assert fleet.replicas[1].router.live_version() == "v1"
+
+
+# -- serve.py surface: Retry-After cap, healthz uptime, fleet admin -------
+
+
+def _load_serve_mod():
+    import importlib.util
+    import os
+
+    from conftest import worker_env
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_mod_fleet", os.path.join(worker_env()[1], "serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_shed_retry_after_is_capped_integer_seconds():
+    """ISSUE 6 satellite: the pipeline-derived Retry-After is emitted
+    as integer seconds (RFC 9110 delay-seconds), rounded UP from the
+    derived estimate, floored at 1, and capped at the configured
+    ceiling — a deep window at a spiked batch cost must not tell
+    clients to come back in ten minutes."""
+    serve_mod = _load_serve_mod()
+
+    class StubBatcher:
+        controller = None
+        max_wait_s = 0.4
+
+        def __init__(self, inflight, costs):
+            self._inflight = inflight
+            self.engine = SimpleNamespace(bucket_costs=lambda: costs)
+
+        def inflight_batches(self):
+            return self._inflight
+
+    # 0.4s wait + (2+1) * 1.2s cost = 4.0 -> exactly 4 (already whole)
+    got = serve_mod.shed_retry_after_s(StubBatcher(2, {16: 1.2}),
+                                       cap_s=30)
+    assert got == 4 and isinstance(got, int)
+    # non-integral estimate rounds UP, never down (an early retry just
+    # sheds again)
+    assert serve_mod.shed_retry_after_s(
+        StubBatcher(1, {16: 1.0}), cap_s=30) == 3    # 0.4 + 2.0 -> 2.4
+    # unbounded derivation hits the cap: 0.4 + 33 * 60s >> 30
+    assert serve_mod.shed_retry_after_s(
+        StubBatcher(32, {16: 60.0}), cap_s=30) == 30
+    # fractional caps floor to whole header seconds
+    assert serve_mod.shed_retry_after_s(
+        StubBatcher(32, {16: 60.0}), cap_s=7.9) == 7
+    # idle pipeline with no cost table floors at 1, never 0
+    assert serve_mod.shed_retry_after_s(StubBatcher(0, {}),
+                                        cap_s=30) == 1
+
+
+def test_healthz_reports_started_at_and_uptime():
+    """ISSUE 6 satellite: /healthz carries the process start (ISO 8601
+    UTC) and a monotone-growing uptime so probes can tell a RESTARTED
+    worker (uptime reset) from a RECOVERED one."""
+    import datetime
+
+    serve_mod = _load_serve_mod()
+
+    class StubRegistry:
+        def live_version(self):
+            return "v1"
+
+        def describe(self):
+            return {"versions": [1]}
+
+    class StubBatcher:
+        def pending_rows(self):
+            return 0
+
+        def inflight_batches(self):
+            return 0
+
+    state = serve_mod.ServerState()
+    code, payload = state.healthz(StubRegistry(), StubBatcher())
+    assert code == 200
+    started = datetime.datetime.fromisoformat(payload["started_at"])
+    assert started.tzinfo is not None            # explicit UTC offset
+    assert abs(started.timestamp() - time.time()) < 5
+    assert payload["uptime_s"] >= 0
+    time.sleep(0.05)
+    _, later = state.healthz(StubRegistry(), StubBatcher())
+    assert later["started_at"] == payload["started_at"]
+    assert later["uptime_s"] > payload["uptime_s"]
+    # single-replica server: no fleet block
+    assert "replicas" not in payload
+
+
+def test_serve_http_fleet_admin_end_to_end():
+    """serve.py --serve-replicas 2: /healthz carries the per-replica
+    block, POST /replicas/{id}/drain|rejoin administer the fleet (404
+    unknown id, 409 for draining the last active replica), /metrics
+    exposes the fleet snapshot, and /predict keeps serving through a
+    drain."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import urllib.error
+    import urllib.request
+
+    from conftest import worker_env
+
+    env, repo = worker_env()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "serve.py"), "--model",
+         "mlp", "--device", "cpu", "--serve-max-batch", "16",
+         "--serve-replicas", "2", "--port", "0",
+         "--metrics-every", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=repo)
+
+    def get(path):
+        return json.loads(urllib.request.urlopen(
+            f"{base}{path}", timeout=30).read())
+
+    def post(path, data=b""):
+        req = urllib.request.Request(f"{base}{path}", data=data)
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, "serve.py exited before announcing readiness"
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "serve_ready":
+                port = rec["port"]
+                break
+        assert port is not None
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 120
+        ok = None
+        while time.monotonic() < deadline:
+            try:
+                ok = get("/healthz")
+                break
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                time.sleep(0.2)
+        assert ok and ok["ok"] is True
+        assert {r["id"] for r in ok["replicas"]} == {"r0", "r1"}
+        assert ok["failovers"] == {"dispatch": 0, "fetch": 0}
+        assert ok["uptime_s"] > 0 and ok["started_at"]
+
+        body = np.zeros(784 * 3, np.uint8).tobytes()
+        out = post("/predict", body)
+        assert out["n"] == 3 and len(out["classes"]) == 3
+
+        drained = post("/replicas/r1/drain")
+        assert drained["replica"]["state"] == "draining"
+        hz = get("/healthz")
+        assert {r["id"]: r["state"] for r in hz["replicas"]} == {
+            "r0": "active", "r1": "draining"}
+        # serving continues on the remaining replica
+        assert post("/predict", body)["n"] == 3
+        # draining the last active replica is a rule refusal
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/replicas/r0/drain")
+        assert ei.value.code == 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/replicas/r9/drain")
+        assert ei.value.code == 404
+        rejoined = post("/replicas/r1/rejoin")
+        assert rejoined["replica"]["state"] == "active"
+        m = get("/metrics")
+        assert m["fleet"]["n_replicas"] == 2
+        assert set(m["by_replica"]) <= {"r0", "r1"}
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)

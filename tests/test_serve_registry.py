@@ -235,7 +235,7 @@ def test_describe_answers_during_warmup(factory):
     seen_during_warm = []
     orig_make = factory.make_engine
 
-    def slow_make(params, version):
+    def slow_make(params, version, replica=0):
         # runs inside add() OUTSIDE the state lock: describe() from
         # another thread must return immediately
         t = threading.Thread(target=lambda: seen_during_warm.append(
@@ -243,7 +243,7 @@ def test_describe_answers_during_warmup(factory):
         t.start()
         t.join(timeout=5)
         assert not t.is_alive(), "describe() blocked during warmup"
-        return orig_make(params, version)
+        return orig_make(params, version, replica=replica)
 
     factory.make_engine = slow_make
     try:
@@ -311,3 +311,112 @@ def test_zero_recompiles_through_hot_swap_under_load(factory, rng):
     # both populations are version-tagged in the metrics
     assert set(metrics.snapshot()["by_version"]) <= {"v1", "v2"}
     assert "v2" in metrics.snapshot()["by_version"]
+
+
+# -- admin races (ISSUE 6 satellite) --------------------------------------
+
+
+def test_sighup_reload_races_admin_promote(factory, tmp_path):
+    """The serve.py coherence contract under admin_lock: a SIGHUP-style
+    load-latest-then-promote (one critical section) racing admin
+    promotes of another version must end with the registry and router
+    agreeing — whichever got the lock last is live, the reload's
+    promote paired with ITS OWN loaded version (never a stale one),
+    and no operation raised."""
+    import threading
+
+    state = _trained_state(factory, seed=3, step=11)
+    ckpt = Checkpointer(str(tmp_path / "c"), async_save=False)
+    ckpt.save(11, state)
+    ckpt.wait()
+    ckpt.close()
+
+    registry, router = _registry(
+        factory, checkpoint_dir=str(tmp_path / "c"))
+    base = registry.add(factory.init_params(0), version="v-base")
+    registry.promote("v-base")
+    admin_lock = threading.Lock()      # serve.py's handler/SIGHUP lock
+    errors = []
+    start = threading.Barrier(2)
+
+    def reload_thread():               # serve.py's _reload body
+        try:
+            start.wait(timeout=10)
+            with admin_lock:
+                mv = registry.load_latest()
+                registry.promote(mv.version)
+        except Exception as e:         # pragma: no cover - the failure
+            errors.append(e)
+
+    def promote_thread():              # admin POST /models/promote
+        try:
+            start.wait(timeout=10)
+            for _ in range(3):
+                with admin_lock:
+                    registry.promote("v-base")
+        except Exception as e:         # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=reload_thread),
+               threading.Thread(target=promote_thread)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "admin race deadlocked"
+    assert not errors, errors
+    live = registry.live_version()
+    assert live in ("v-base", "step-11")
+    # registry and router agree, and the loaded version is resident
+    # and warmed regardless of who won
+    assert registry.get(live).state == "live"
+    assert router.live_version() == live
+    loaded = registry.get("step-11")
+    assert loaded.state in ("ready", "live") and loaded.engines
+
+
+def test_eviction_races_concurrent_promote(factory):
+    """Registry eviction (adds overflowing max_versions) racing a
+    promote flip-flop between two residents: the only acceptable
+    client-visible error is a KeyError for a version eviction already
+    removed; afterwards the registry is coherent — live is resident,
+    residency is within the cap, and no in-route version was evicted."""
+    import threading
+
+    registry, router = _registry(factory, max_versions=3)
+    registry.add(factory.init_params(0), version="keep-a")
+    registry.add(factory.init_params(1), version="keep-b")
+    registry.promote("keep-a")
+    errors = []
+    stop = threading.Event()
+
+    def promoter():
+        flip = ["keep-a", "keep-b"]
+        i = 0
+        try:
+            while not stop.is_set():
+                try:
+                    registry.promote(flip[i % 2])
+                except KeyError:
+                    pass               # evicted while routeless: allowed
+                i += 1
+        except Exception as e:         # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=promoter)
+    t.start()
+    try:
+        for k in range(4):             # each add may evict the oldest
+            registry.add(factory.init_params(10 + k),
+                         version=f"filler-{k}")
+    finally:
+        stop.set()
+        t.join(timeout=120)
+    assert not t.is_alive() and not errors, errors
+    desc = registry.describe()
+    residents = {v["version"] for v in desc["versions"]}
+    assert len(residents) <= 3
+    live = registry.live_version()
+    assert live in residents, (live, residents)
+    assert registry.get(live).state == "live"
+    assert router.versions_in_route() <= residents
