@@ -180,6 +180,21 @@ def main(argv=None) -> int:
                    help="[serve] enable hedged tail dispatch in the "
                         "fleet (duplicate overdue batches on a free "
                         "healthy sibling)")
+    p.add_argument("--serve-infer-dtype", default=None,
+                   choices=["float32", "bfloat16", "int8", "auto"],
+                   help="[serve] serving precision for the headline "
+                        "phases: float32 = training-identical reference "
+                        "forward; bfloat16/int8 = the quantized+fused "
+                        "fast path behind the parity gate; auto = "
+                        "cheapest parity-passing variant (default "
+                        "float32)")
+    p.add_argument("--dtype-sweep", action="store_true", default=None,
+                   help="[serve] add the inference fast-path leg: warm "
+                        "+ parity-gate bf16 and int8 variants, then "
+                        "run f32/bf16/int8 closed-loop back-to-back in "
+                        "this process — one record with per-dtype "
+                        "img/s/chip, parity metrics, bucket cost "
+                        "tables and recompile counts (must stay 0)")
     p.add_argument("--baseline", default=None, metavar="BENCH_serve.json",
                    help="[serve] a prior BENCH_serve_r*.json to diff "
                         "against: prints a delta table and REFUSES "
@@ -230,6 +245,8 @@ def main(argv=None) -> int:
                    "--no-adaptive": args.no_adaptive,
                    "--serve-replicas": args.serve_replicas,
                    "--serve-hedge": args.serve_hedge,
+                   "--serve-infer-dtype": args.serve_infer_dtype,
+                   "--dtype-sweep": args.dtype_sweep,
                    "--baseline": args.baseline,
                    "--chaos": args.chaos,
                    "--swap-during-load": args.swap_during_load,
@@ -1053,6 +1070,124 @@ def _serve_fleet_leg(fleet, metrics, make_batcher, clients: int,
     return leg
 
 
+def _serve_dtype_sweep(registry, router, factory, metrics, make_batcher,
+                       compiles, pipelined: int, clients: int,
+                       duration: float) -> dict:
+    """The inference fast-path proof leg (ISSUE 7 acceptance): warm +
+    parity-gate the bf16 and int8 variants of the live version, then
+    run float32 / bfloat16 / int8 closed-loop BACK-TO-BACK in this one
+    process — same request stream, same batcher knobs, same silicon —
+    so the per-dtype img/s/chip numbers are a controlled comparison
+    inside one record, not a cross-run guess.
+
+    The request stream is a seeded mixed-size mix (uniform sizes up to
+    32) so drains land across the bucket ladder's mid rungs, where the
+    fast path's win actually lives; every sub-phase coalesces with the
+    SAME cost-derived wait (one full-batch service time off the f32
+    table — the ragged leg's balance point). Each dtype phase asserts
+    its own recompile count (the variants were fully pre-warmed and
+    gate-verified, so steady state must stay 0), and the leg reports
+    each variant's parity verdict + per-dtype bucket cost table — the
+    same tables the PR 4 batch former and the PR 6 hedge threshold
+    re-price from at promote time. A variant the gate REFUSED shows up
+    as skipped-with-reason, never as a measured leg."""
+    import numpy as np
+
+    from distributedmnist_tpu.serve.scheduler import fit_dispatch_cost
+
+    version = registry.live_version()
+    restore_dtype = router.live_infer_dtype() or "float32"
+    max_size = min(32, factory.max_batch)
+    rng = np.random.default_rng(11)
+    sizes = [int(s) for s in rng.integers(1, max_size + 1, 256)]
+    reqs = [rng.integers(0, 256, (n, 28, 28, 1), dtype=np.uint8)
+            for n in sizes]
+    warmup_events = 0
+    skipped = {}
+    for dt in ("bfloat16", "int8"):
+        # Warmup-compile accounting by COUNTER DELTA around the call,
+        # not by the variant's own bookkeeping: a variant the headline
+        # activation already warmed compiles nothing here (delta 0 —
+        # its events predate the caller's steady_from snapshot and
+        # counting them again would over-subtract into a negative
+        # recompile figure), while a gate-REFUSED variant's engines
+        # still compiled before the gate ran and those events must be
+        # excluded from the steady window even though the build raised.
+        before_compiles = compiles.snapshot()
+        try:
+            registry.add_variant(version, dt)
+        except Exception as e:
+            # the refusal (with its parity verdict) is the leg's
+            # result for this dtype — never a silently-measured one
+            skipped[dt] = f"{type(e).__name__}: {e}"
+            _mark(f"dtype sweep: {dt} variant REFUSED ({e})")
+        warmup_events += compiles.snapshot() - before_compiles
+    # f32 cost table exists (bootstrap warmup); derive the shared wait
+    overhead_s, per_row_s = fit_dispatch_cost(router.bucket_costs())
+    wait_us = max(2000, int(
+        (overhead_s + per_row_s * factory.buckets[-1]) * 1e6))
+    n_chips = factory.total_chips
+    mv = registry.get(version)
+    legs = {}
+    for dt in ("float32", "bfloat16", "int8"):
+        if dt in skipped:
+            legs[dt] = {"skipped": skipped[dt]}
+            continue
+        registry.promote(version, infer_dtype=dt)
+        steady = compiles.snapshot()
+        b = make_batcher(pipelined, adaptive=False, wait_us=wait_us)
+        try:
+            _mark(f"dtype sweep closed loop [{dt}]: {clients} clients "
+                  f"x {duration:.0f}s, sizes U[1,{max_size}], wait "
+                  f"{wait_us}us")
+            closed = _serve_closed_loop(b, metrics, reqs, clients,
+                                        duration)
+        finally:
+            b.stop()
+        vi = mv.variants.get(dt)
+        legs[dt] = {
+            "img_s_chip": round(closed["rows_per_sec"] / n_chips, 1),
+            "requests_per_sec": closed["requests_per_sec"],
+            "latency_ms": closed["latency_ms"],
+            "mean_rows_per_batch": closed["mean_rows_per_batch"],
+            "by_dtype": closed["by_dtype"],
+            # steady state under an ALREADY-warmed, gate-verified
+            # variant: any nonzero count here is a jit cache that
+            # failed to key on dtype
+            "recompiles_after_warmup": compiles.snapshot() - steady,
+            "bucket_cost_ms": {str(bk): round(c * 1e3, 3)
+                               for bk, c in sorted(
+                                   router.bucket_costs().items())},
+            "parity": vi.parity if vi is not None else None,
+        }
+        _mark(f"dtype sweep [{dt}]: {legs[dt]['img_s_chip']} img/s/chip "
+              f"(p99 {closed['latency_ms']['p99']} ms, "
+              f"{legs[dt]['recompiles_after_warmup']} recompiles)")
+    registry.promote(version, infer_dtype=restore_dtype)
+    f32 = legs.get("float32", {}).get("img_s_chip")
+    speedups = {dt: (round(leg["img_s_chip"] / f32, 3)
+                     if f32 and "img_s_chip" in leg else None)
+                for dt, leg in legs.items() if dt != "float32"}
+    measured = {dt: s for dt, s in speedups.items() if s is not None}
+    best = max(measured, key=measured.get) if measured else None
+    leg = {
+        "sizes": f"uniform[1..{max_size}]",
+        "seed": 11,
+        "coalesce_wait_us": wait_us,
+        "clients": clients,
+        "duration_s": duration,
+        "legs": legs,
+        "speedup_vs_float32": speedups,
+        "best_dtype": best,
+        "best_speedup": measured.get(best),
+        # the variants' legitimate warmup compiles, for the caller's
+        # whole-run recompile exclusion (same treatment as --swap's)
+        "variant_warmup_compile_events": warmup_events,
+    }
+    _mark(f"dtype sweep: speedups vs f32 {speedups} (best {best})")
+    return leg
+
+
 def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
                      compiles, pipelined: int, duration: float,
                      qps: float) -> dict:
@@ -1333,6 +1468,11 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
             base_chaos.get("p99_under_faults_ms")),
         "chaos_failovers": (cur_chaos.get("failovers"),
                             base_chaos.get("failovers")),
+        # the fast-path signal (ISSUE 7): best dtype speedup vs f32 in
+        # the same-record sweep (None-vs-None without --dtype-sweep)
+        "dtype_sweep_best_speedup": (
+            (cur_d.get("dtype_sweep") or {}).get("best_speedup"),
+            (base_d.get("dtype_sweep") or {}).get("best_speedup")),
     }
     delta = {"path": path,
              "baseline_value": baseline.get("value"),
@@ -1388,14 +1528,19 @@ def _git_provenance() -> dict:
     return prov
 
 
-def _host_provenance(factory) -> dict:
+def _host_provenance(factory, infer_dtype: str = "float32") -> dict:
     """Host + accelerator + code identity for the serve artifact: which
     machine, which silicon, and which commit produced the number.
     `device_kind` is the honest chip name ('cpu' on the virtual mesh,
     'TPU v4' etc. on real hardware); chip_count restates the
-    normalization denominator."""
+    normalization denominator. `infer_dtype` + `fused_kernels` record
+    which PRECISION and hot-op route produced the headline (ISSUE 7
+    satellite): an int8 record must be as self-locating as a CPU one —
+    --baseline refuses cross-dtype deltas exactly like cross-silicon."""
     import platform as platform_mod
     import socket
+
+    from distributedmnist_tpu.ops import fused as fused_lib
 
     return {
         "hostname": socket.gethostname(),
@@ -1407,6 +1552,10 @@ def _host_provenance(factory) -> dict:
         # the whole fleet's distinct chips (== the per-replica count on
         # a single-replica build) — the img/s/chip denominator
         "chip_count": getattr(factory, "total_chips", factory.n_chips),
+        # the headline engines' serving precision + resolved fused mode
+        "infer_dtype": infer_dtype,
+        "fused_kernels": fused_lib.resolve(
+            getattr(factory, "fused", "auto"), factory.platform),
         **_git_provenance(),
     }
 
@@ -1571,6 +1720,27 @@ def _serve(args) -> int:
           f"{list(factory.buckets)}")
     boot = registry.bootstrap(seed=cfg.seed)   # load + pre-warm + promote
     warm_compiles = boot.warmup_compile_events
+    # Headline serving precision (ISSUE 7): warm + parity-gate the
+    # requested variant(s) and promote the pick BEFORE any measured
+    # phase. An explicitly requested dtype whose variant the gate
+    # refuses fails the bench — the measurement was asked for at a
+    # precision that must never serve.
+    if args.serve_infer_dtype and args.serve_infer_dtype != "float32":
+        _mark(f"activating inference fast path: "
+              f"{args.serve_infer_dtype}")
+        registry.activate_infer_dtype(boot.version,
+                                      args.serve_infer_dtype)
+    headline_dtype = router.live_infer_dtype() or "float32"
+    if baseline_rec is not None:
+        base_dtype = (baseline_rec["detail"]["host"].get("infer_dtype")
+                      or "float32")   # pre-ISSUE 7 records were all f32
+        if base_dtype != headline_dtype:
+            _mark(f"REFUSING --baseline {args.baseline}: it was "
+                  f"measured at infer_dtype={base_dtype!r}, this run "
+                  f"serves {headline_dtype!r} — cross-dtype serve "
+                  "deltas are meaningless (an int8 record must not "
+                  "masquerade as an f32 win)")
+            return 4
     compiles = CompileCounter.instance()
     steady_from = compiles.snapshot()
 
@@ -1712,6 +1882,17 @@ def _serve(args) -> int:
         fleet_leg = _serve_fleet_leg(fleet, metrics, make_batcher,
                                      clients, duration, req)
 
+    # Phase 4c (optional) — the dtype sweep (ISSUE 7 acceptance):
+    # f32/bf16/int8 closed-loop back-to-back behind the parity gate,
+    # before the chaos leg so an injected storm can't contaminate the
+    # comparison. Variant warmups are legitimate warmup compiles,
+    # excluded from the whole-run recompile check below.
+    dtype_sweep = None
+    if args.dtype_sweep:
+        dtype_sweep = _serve_dtype_sweep(registry, router, factory,
+                                         metrics, make_batcher, compiles,
+                                         pipelined, clients, duration)
+
     # Phase 5 (optional) — the chaos leg (ISSUE 5 acceptance): seeded
     # fault schedule against the resilience stack, after the clean
     # phases so an injected storm can't contaminate the happy-path
@@ -1733,6 +1914,9 @@ def _serve(args) -> int:
     if chaos is not None:
         # same exclusion for the chaos fallback's off-hot-path warmup
         recompiles -= chaos["fallback_warmup_compile_events"]
+    if dtype_sweep is not None:
+        # and for the sweep variants' off-hot-path warmups
+        recompiles -= dtype_sweep["variant_warmup_compile_events"]
     if recompiles:
         _mark(f"WARNING: {recompiles} compile events after warmup — "
               "steady state was supposed to be shape-stable")
@@ -1755,7 +1939,7 @@ def _serve(args) -> int:
             # numbers (like the 1.08x PR 2 result) must never be
             # conflated with TPU headlines when comparing rounds — the
             # host block makes every BENCH_serve_r*.json self-locating.
-            "host": _host_provenance(factory),
+            "host": _host_provenance(factory, infer_dtype=headline_dtype),
             "buckets": list(factory.buckets),
             "max_batch": factory.max_batch,
             "max_wait_us": max_wait_us,
@@ -1778,6 +1962,12 @@ def _serve(args) -> int:
             "ragged": ragged,
             "swap": swap,
             "chaos": chaos,
+            # The inference fast-path leg (ISSUE 7; None without
+            # --dtype-sweep): per-dtype closed-loop capacity, parity
+            # verdicts, per-dtype bucket cost tables, per-dtype
+            # recompile counts (all 0), and the speedup-vs-f32 pair the
+            # acceptance bar reads.
+            "dtype_sweep": dtype_sweep,
             # The fleet block (ISSUE 6; None on single-replica runs):
             # per-replica provenance — which devices each replica owns
             # and whether the slices are disjoint silicon or logical

@@ -163,6 +163,16 @@ class Config:
     serve_replica_inflight: Optional[int] = None
     serve_hedge: bool = False
     serve_retry_after_cap_s: float = 30.0
+    # Inference fast path (ISSUE 7, serve/quantize.py): the serving
+    # precision. "float32" runs the training-identical reference
+    # forward; "bfloat16"/"int8" run the inference-specialized low-
+    # precision path (int8 = per-output-channel weight quantization),
+    # which only takes traffic after the registry's zero-compile
+    # prove-it pass AND the accuracy-parity gate vs the f32 reference
+    # (argmax agreement + relative logit diff, thresholds in PARITY.md).
+    # "auto" warms+gates every variant and serves the cheapest
+    # parity-passing one by the warmup-measured bucket cost tables.
+    serve_infer_dtype: str = "float32"
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -318,6 +328,16 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "cost estimate with a duplicate dispatch on a "
                         "free healthy sibling replica (first result "
                         "wins)")
+    p.add_argument("--serve-infer-dtype",
+                   choices=["float32", "bfloat16", "int8", "auto"],
+                   default=None,
+                   help="[serving] inference precision: float32 = the "
+                        "training-identical reference forward; "
+                        "bfloat16/int8 = the quantized+fused fast path "
+                        "(takes traffic only after the zero-compile "
+                        "prove-it pass AND the accuracy-parity gate); "
+                        "auto = cheapest parity-passing variant by the "
+                        "warmup cost tables")
     p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "

@@ -54,8 +54,17 @@ def _dense_relu_kernel(x_ref, w_ref, b_ref, o_ref):
     o_ref[...] = jnp.maximum(acc, 0.0).astype(o_ref.dtype)
 
 
-def _dense_relu_fwd_pallas(x: jax.Array, w: jax.Array, b: jax.Array,
-                           interpret: bool) -> jax.Array:
+def _tiled_dense_call(kernel, x: jax.Array, w: jax.Array,
+                      channel_rows: list, out_dtype,
+                      interpret: bool) -> jax.Array:
+    """The one M x N tiling scaffold every fused dense kernel runs on
+    (pallas_guide.md playbook: block over M x N with MXU-friendly
+    tiles, keep the reduction dim whole in VMEM): pad (m, k) x and
+    (k, n) w up to the tile grid, pad each per-output-channel vector in
+    `channel_rows` (bias, dequant scales, ...) along n and hand them to
+    `kernel` as (1, bn) blocks, slice the padding back off the (m, n)
+    result. One definition, so a tiling-rule change can never diverge
+    between the training kernel and the inference epilogues."""
     m, k = x.shape
     k2, n = w.shape
     assert k == k2, (x.shape, w.shape)
@@ -67,22 +76,27 @@ def _dense_relu_fwd_pallas(x: jax.Array, w: jax.Array, b: jax.Array,
         x = jnp.pad(x, ((0, pad_m), (0, 0)))
     if pad_n:
         w = jnp.pad(w, ((0, 0), (0, pad_n)))
-        b = jnp.pad(b, (0, pad_n))
+        channel_rows = [jnp.pad(r, (0, pad_n)) for r in channel_rows]
     mp, np_ = m + pad_m, n + pad_n
-    b2 = b.reshape(1, np_)
     out = pl.pallas_call(
-        _dense_relu_kernel,
+        kernel,
         grid=(mp // bm, np_ // bn),
         in_specs=[
             pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
             pl.BlockSpec((k, bn), lambda i, j: (0, j)),
-            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
-        ],
+        ] + [pl.BlockSpec((1, bn), lambda i, j: (0, j))
+             for _ in channel_rows],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
         interpret=interpret,
-    )(x, w, b2)
+    )(x, w, *[r.reshape(1, np_) for r in channel_rows])
     return out[:m, :n]
+
+
+def _dense_relu_fwd_pallas(x: jax.Array, w: jax.Array, b: jax.Array,
+                           interpret: bool) -> jax.Array:
+    return _tiled_dense_call(_dense_relu_kernel, x, w, [b], x.dtype,
+                             interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -113,3 +127,77 @@ dense_relu.defvjp(_fwd, _bwd)
 def dense_relu_reference(x, w, b):
     """XLA reference implementation — the equivalence oracle in tests."""
     return jnp.maximum(x @ w + b, 0.0)
+
+
+# -- forward-only inference epilogues (serve/quantize.py fast path) --------
+#
+# The serving engines never differentiate, so their fused ops skip the
+# custom-VJP wrapper entirely: dense_relu_inference is the same fused
+# dense+bias+relu as dense_relu but dispatchable on a RESOLVED mode, and
+# quant_dense is its int8 weight-quantized sibling — the scaled
+# int8 x int8 -> int32 matmul with the f32 dequant (+bias, optional relu)
+# folded into the kernel epilogue (pallas_guide.md quantization pattern:
+# int32 accumulate on the MXU, per-output-channel scales applied once on
+# the way out). On non-TPU platforms the Pallas paths run in interpret
+# mode — the equivalence tests' route — while production CPU serving uses
+# the XLA mode (serve/quantize.py dequantizes at build there; interpret
+# mode is a correctness vehicle, not a fast path).
+
+
+def dense_relu_inference(x: jax.Array, w: jax.Array, b: jax.Array,
+                         mode: str = XLA) -> jax.Array:
+    """relu(x @ w + b), forward-only, on a resolved kernel mode. The
+    XLA arm IS dense_relu_reference — one definition, so the oracle the
+    equivalence tests compare against can never drift from the
+    production route."""
+    if mode == XLA:
+        return dense_relu_reference(x, w, b)
+    if mode in (PALLAS, PALLAS_INTERPRET):
+        return _dense_relu_fwd_pallas(x, w, b, mode == PALLAS_INTERPRET)
+    raise ValueError(f"unresolved fused-kernel mode {mode!r}")
+
+
+def _quant_dense_kernel(relu, x_ref, w_ref, s_ref, b_ref, o_ref):
+    # int8 x int8 on the MXU accumulates in int32; the dequant epilogue
+    # (per-output-channel scale, f32 bias, optional relu) runs on the
+    # VPU before the tile ever leaves VMEM.
+    acc = jnp.dot(x_ref[...], w_ref[...],
+                  preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * s_ref[...] + b_ref[...]
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    o_ref[...] = out
+
+
+def quant_dense(x_q: jax.Array, w_q: jax.Array, scale: jax.Array,
+                b: jax.Array, relu: bool = True,
+                mode: str = XLA) -> jax.Array:
+    """Weight-quantized dense layer: (x_q @ w_q) * scale + b, optionally
+    relu'd, returning float32.
+
+    x_q (m, k) int8, w_q (k, n) int8, scale (n,) float32 — the COMBINED
+    dequant factor (weight scale x activation scale; the caller folds its
+    activation quantization step in), b (n,) float32.
+    """
+    if x_q.dtype != jnp.int8 or w_q.dtype != jnp.int8:
+        raise TypeError(
+            f"quant_dense wants int8 operands, got {x_q.dtype} @ "
+            f"{w_q.dtype}")
+    if mode == XLA:
+        return quant_dense_reference(x_q, w_q, scale, b, relu=relu)
+    if mode not in (PALLAS, PALLAS_INTERPRET):
+        raise ValueError(f"unresolved fused-kernel mode {mode!r}")
+    return _tiled_dense_call(
+        functools.partial(_quant_dense_kernel, relu), x_q, w_q,
+        [jnp.asarray(scale, jnp.float32), jnp.asarray(b, jnp.float32)],
+        jnp.float32, mode == PALLAS_INTERPRET)
+
+
+def quant_dense_reference(x_q, w_q, scale, b, relu: bool = True):
+    """Plain-jnp oracle for quant_dense — the equivalence tests compare
+    the Pallas-interpret kernel against THIS, and it is also exactly the
+    XLA-mode implementation (one definition, asserted equal)."""
+    acc = jax.lax.dot_general(x_q, w_q, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * scale + b
+    return jnp.maximum(out, 0.0) if relu else out

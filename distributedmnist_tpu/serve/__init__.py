@@ -16,6 +16,10 @@ north star's "serves heavy traffic from millions of users".
               rollback events
 - router.py   version-aware dispatch between batcher and engines:
               hot-swap, shadow duplication, canary splitting
+- quantize.py the low-precision inference fast path (ISSUE 7): per-
+              output-channel int8 weight quantization + the bf16/int8
+              inference-specialized forwards, served only behind the
+              registry's accuracy-parity gate
 - faults.py   config-driven fault injection: named failpoints woven
               through every serving layer, fully inert when disabled
 - resilience.py deadline shedding, poison-batch bisection policy, the
@@ -74,6 +78,16 @@ _EXPORTS = {
                          "build_resilience"),
     "HealthTracker": ("distributedmnist_tpu.serve.resilience",
                       "HealthTracker"),
+    "quantize_channelwise": ("distributedmnist_tpu.serve.quantize",
+                             "quantize_channelwise"),
+    "prepare_inference": ("distributedmnist_tpu.serve.quantize",
+                          "prepare_inference"),
+    "INFER_DTYPES": ("distributedmnist_tpu.serve.quantize",
+                     "INFER_DTYPES"),
+    "VariantInfo": ("distributedmnist_tpu.serve.registry",
+                    "VariantInfo"),
+    "PARITY_GATES": ("distributedmnist_tpu.serve.registry",
+                     "PARITY_GATES"),
     "ReplicaSet": ("distributedmnist_tpu.serve.fleet", "ReplicaSet"),
     "FleetHandle": ("distributedmnist_tpu.serve.fleet", "FleetHandle"),
     "NoReplicaAvailable": ("distributedmnist_tpu.serve.fleet",

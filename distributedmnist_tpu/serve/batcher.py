@@ -596,7 +596,8 @@ class DynamicBatcher:
                 self.metrics.record_batch(
                     rows=rows, bucket=handle.bucket,
                     queue_depth=self.pending_rows(), version=version,
-                    replica=getattr(handle, "replica", None))
+                    replica=getattr(handle, "replica", None),
+                    infer_dtype=getattr(handle, "infer_dtype", None))
                 for r in batch:
                     self.metrics.record_latency(t_done - r.t_enqueue,
                                                 rows=r.n, version=version)

@@ -53,6 +53,8 @@ class InferenceHandle:
     staging: Optional[np.ndarray]  # recycled on fetch; None after
     version: Optional[str] = None  # the model version that computed it
     #   (serve/registry.py labels; metrics split populations on it)
+    infer_dtype: Optional[str] = None  # the computing engine's serving
+    #   precision (ISSUE 7; metrics by_dtype attribution)
 
 
 def make_buckets(max_batch: int, n_chips: int,
@@ -83,11 +85,14 @@ class InferenceEngine:
     def __init__(self, model, params, mesh, dtype=None,
                  max_batch: int = 512,
                  buckets: Optional[Sequence[int]] = None,
-                 version: Optional[str] = None):
+                 version: Optional[str] = None,
+                 infer_dtype: str = "float32",
+                 fused_mode: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from distributedmnist_tpu.ops import fused as fused_lib
         from distributedmnist_tpu.parallel import replicated
         from distributedmnist_tpu.parallel.mesh import DATA_AXIS
 
@@ -98,6 +103,17 @@ class InferenceEngine:
         self.n_chips = int(np.prod(mesh.devices.shape))
         self.platform = mesh.devices.flat[0].platform
         self.dtype = dtype if dtype is not None else jnp.float32
+        # The serving precision (ISSUE 7): "float32" runs the
+        # training-identical forward (the parity oracle — same model
+        # apply, same numerics as eval); "bfloat16"/"int8" run the
+        # inference fast path (serve/quantize.py — folded input
+        # normalization, inference conv route, fused dense epilogues,
+        # int8 weights per-output-channel quantized at THIS build).
+        # fused_mode resolves the Pallas-vs-XLA hot-op route against
+        # the mesh's platform, ops.fused.resolve-style.
+        self.infer_dtype = infer_dtype
+        self.fused_mode = fused_lib.resolve(fused_mode or "auto",
+                                            self.platform)
         self.max_batch = max_batch
         self.buckets = (tuple(sorted(set(buckets))) if buckets
                         else make_buckets(max_batch, self.n_chips))
@@ -105,17 +121,29 @@ class InferenceEngine:
             raise ValueError(
                 f"buckets {self.buckets} must be multiples of the "
                 f"data-parallel width {self.n_chips}")
-        self.params = jax.device_put(params, replicated(mesh))
         self._x_sharding = NamedSharding(mesh, P(DATA_AXIS, None, None,
                                                  None))
         out_spec = NamedSharding(mesh, P(DATA_AXIS, None))
 
-        def forward(params, x_u8):
-            # cast + /255 in-step: fuses into the first conv/matmul, and
-            # the host->device copy stays uint8 (4x smaller than f32).
-            x = x_u8.astype(self.dtype) / 255.0
-            logits = model.apply({"params": params}, x)
-            return jax.lax.with_sharding_constraint(logits, out_spec)
+        if infer_dtype == "float32":
+            def forward(params, x_u8):
+                # cast + /255 in-step: fuses into the first conv/matmul,
+                # and the host->device copy stays uint8 (4x smaller than
+                # f32).
+                x = x_u8.astype(self.dtype) / 255.0
+                logits = model.apply({"params": params}, x)
+                return jax.lax.with_sharding_constraint(logits, out_spec)
+        else:
+            from distributedmnist_tpu.serve.quantize import \
+                prepare_inference
+
+            params, fast_forward = prepare_inference(
+                model, params, infer_dtype, self.fused_mode)
+
+            def forward(params, x_u8):
+                logits = fast_forward(params, x_u8)
+                return jax.lax.with_sharding_constraint(logits, out_spec)
+        self.params = jax.device_put(params, replicated(mesh))
 
         # Donated input: the uint8 batch buffer is dead after the gather/
         # cast, so XLA may reuse it (a no-op with a warning on backends
@@ -219,7 +247,8 @@ class InferenceEngine:
         x_dev = jax.device_put(staging, self._x_sharding)
         logits = self._forward(self.params, x_dev)
         return InferenceHandle(logits=logits, n=n, bucket=b,
-                               staging=staging, version=self.version)
+                               staging=staging, version=self.version,
+                               infer_dtype=self.infer_dtype)
 
     def fetch(self, handle: InferenceHandle) -> np.ndarray:
         """Phase 2: the device->host VALUE fetch (blocks until the
@@ -283,8 +312,8 @@ class InferenceEngine:
         self._bucket_cost = costs
         self._bucket_cost_p95 = costs_p95
         n = self._compiles.snapshot() - before
-        log.info("serve engine warm: %d buckets %s (%d compile events); "
-                 "bucket cost ms %s",
+        log.info("serve engine warm [%s]: %d buckets %s (%d compile "
+                 "events); bucket cost ms %s", self.infer_dtype,
                  len(self.buckets), list(self.buckets), n,
                  {b: round(c * 1e3, 3)
                   for b, c in sorted(self._bucket_cost.items())})
@@ -354,6 +383,11 @@ def build_engine(cfg) -> InferenceEngine:
     from distributedmnist_tpu import optim
     from distributedmnist_tpu.trainer import init_state
 
+    if cfg.serve_infer_dtype == "auto":
+        raise ValueError(
+            "serve_infer_dtype='auto' needs the registry's parity gate "
+            "to pick a variant (serve/registry.py); the single-engine "
+            "path takes a concrete dtype")
     model, mesh, dtype = build_model_and_mesh(cfg)
     tx = optim.build(cfg.optimizer, cfg.learning_rate, cfg.momentum,
                      flat=cfg.flat_optimizer)
@@ -374,4 +408,6 @@ def build_engine(cfg) -> InferenceEngine:
             log.info("serving params restored from step %d",
                      int(state.step))
     return InferenceEngine(model, state.params, mesh, dtype=dtype,
-                           max_batch=cfg.serve_max_batch)
+                           max_batch=cfg.serve_max_batch,
+                           infer_dtype=cfg.serve_infer_dtype,
+                           fused_mode=cfg.fused_kernels)

@@ -70,7 +70,7 @@ from typing import Optional
 KNOWN_FAILPOINTS = frozenset((
     "engine.dispatch", "engine.fetch", "batch.dispatch",
     "router.shadow", "registry.restore", "registry.warmup",
-    "replica.dispatch", "replica.fetch"))
+    "registry.variant", "replica.dispatch", "replica.fetch"))
 
 
 class InjectedFault(RuntimeError):

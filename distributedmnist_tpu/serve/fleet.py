@@ -125,6 +125,10 @@ class FleetHandle:
     x: Any                          # host payload, for redispatch
     cost_s: float                   # reserved estimate, released as-is
     t_dispatch: float
+    # serving precision of the computing engine (ISSUE 7); re-stamped
+    # alongside (version, replica) when failover or a winning hedge
+    # moves the computation
+    infer_dtype: Optional[str] = None
 
 
 class ReplicaSet:
@@ -243,6 +247,11 @@ class ReplicaSet:
 
     def live_version(self) -> Optional[str]:
         return self.replicas[0].router.live_version()
+
+    def live_infer_dtype(self) -> Optional[str]:
+        # identical across replicas (version rolls fan out under the
+        # pick lock); replica 0 speaks for all
+        return self.replicas[0].router.live_infer_dtype()
 
     def routes(self) -> dict:
         # identical across replicas by construction (every mutation
@@ -403,7 +412,8 @@ class ReplicaSet:
         self._mark_dispatched(rep, n)
         return FleetHandle(inner=rh, replica=rep.rid, version=rh.version,
                            n=rh.n, bucket=rh.bucket, x=parts,
-                           cost_s=cost_s, t_dispatch=time.monotonic())
+                           cost_s=cost_s, t_dispatch=time.monotonic(),
+                           infer_dtype=getattr(rh, "infer_dtype", None))
 
     def _fetch_on(self, rep: _Replica, fh_or_rh, version, n: int
                   ) -> np.ndarray:
@@ -497,6 +507,7 @@ class ReplicaSet:
         # may differ from the original dispatch's (a roll landed in
         # between) — the re-tag keeps by_version/by_replica honest.
         fh.replica, fh.version = sib.rid, rescued.version
+        fh.infer_dtype = rescued.infer_dtype
         return out
 
     def _hedge_threshold(self, bucket: int) -> Optional[float]:
@@ -557,7 +568,8 @@ class ReplicaSet:
             # rule as the failover rescue)
             self._record(sib, ok=True,
                          latency_s=time.monotonic() - dup.t_dispatch)
-            finish("hedge", True, (out, dup.version, sib.rid))
+            finish("hedge", True, (out, dup.version, sib.rid,
+                                   dup.infer_dtype))
 
         with self._cond:
             self._hedges += 1
@@ -572,8 +584,9 @@ class ReplicaSet:
                         if hedge_won:
                             with self._cond:
                                 self._hedge_wins += 1
-                            out, version, rid = value
+                            out, version, rid, dtype = value
                             fh.replica, fh.version = rid, version
+                            fh.infer_dtype = dtype
                         else:
                             out = value
                         if self.metrics is not None:

@@ -93,6 +93,11 @@ class ServeMetrics:
             # by_version), plus the failover/hedge counters — how often
             # redundancy, not retry, absorbed a fault.
             self._by_replica: dict[str, dict] = {}
+            # per-precision batch populations (ISSUE 7): attribution
+            # rides the handle's infer_dtype tag like version/replica —
+            # after a dtype promote, the split says which precision
+            # actually served the window.
+            self._by_dtype: dict[str, dict] = {}
             self._failovers: dict[str, int] = {}   # kind -> count
             self._last_failover = None     # {"kind", "from", "to", "at"}
             self._hedges = 0
@@ -137,7 +142,7 @@ class ServeMetrics:
 
     def record_batch(self, rows: int, bucket: int,
                      queue_depth: int, version: str = None,
-                     replica: str = None) -> None:
+                     replica: str = None, infer_dtype: str = None) -> None:
         with self._lock:
             self._batches += 1
             occ = self._occupancy.setdefault(bucket, [0, 0])
@@ -152,6 +157,11 @@ class ServeMetrics:
             if replica is not None:
                 s = self._by_replica.setdefault(
                     replica, {"batches": 0, "rows": 0})
+                s["batches"] += 1
+                s["rows"] += rows
+            if infer_dtype is not None:
+                s = self._by_dtype.setdefault(
+                    infer_dtype, {"batches": 0, "rows": 0})
                 s["batches"] += 1
                 s["rows"] += rows
 
@@ -359,6 +369,8 @@ class ServeMetrics:
                 "shadow_dropped": self._shadow_dropped,
                 "by_replica": {r: dict(s) for r, s in
                                sorted(self._by_replica.items())},
+                "by_dtype": {d: dict(s) for d, s in
+                             sorted(self._by_dtype.items())},
                 "fleet": {
                     "failovers": dict(self._failovers),
                     "failovers_total": sum(self._failovers.values()),

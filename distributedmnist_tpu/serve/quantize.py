@@ -1,0 +1,279 @@
+"""Low-precision inference fast path: weight quantization + the
+inference-specialized forwards the bf16/int8 serving engines run.
+
+The float32 serving engine intentionally runs the TRAINING forward —
+same model object, same numerics as eval, which is what makes it the
+parity oracle (shadow comparisons, the registry's variant gate) and
+keeps checkpoints bit-faithful. This module builds the other half of the
+Clipper "model optimization" layer (PAPERS.md): a forward specialized
+for inference-only execution, in a lower precision, that a variant may
+serve ONLY after the registry's accuracy-parity gate passes
+(serve/registry.py; thresholds in PARITY.md).
+
+What the fast path changes relative to the training forward:
+
+- **weight quantization (int8)**: per-output-channel symmetric scales
+  computed once at load — scale[j] = max|W[:, j]| / 127, Wq = round(W /
+  scale) — for every dense AND conv kernel. The quantization round-trip
+  is baked into whatever the compute route uses, so the parity gate
+  always measures the real accuracy cost.
+- **folded input normalization**: the training forward computes
+  x.astype(dtype)/255 over every pixel of every batch; inference folds
+  the 1/255 into the first layer's scales at load, so the hot path casts
+  and multiplies nothing it doesn't have to.
+- **inference conv route**: convs run as im2col patch matmuls
+  (ops/conv.py) on every platform — GEMMs are the fast path on the MXU
+  *and* on this repo's CPU bench host (measured ~1.5-3x over the lax
+  conv lowering at serving batch sizes); training keeps lax convs on CPU
+  because that choice is about the BACKWARD pass, which serving never
+  runs.
+- **fused dense epilogues**: the dense+bias+relu chain goes through
+  ops/fused.py's forward-only inference ops — the Pallas kernel on TPU
+  (int8 x int8 -> int32 with the f32 dequant epilogue fused), interpret
+  mode for CPU tests, plain XLA on the CPU serving path (XLA CPU has no
+  fast integer GEMM, so the int8 engine dequantizes its int8 weights
+  once at build there and runs f32 GEMMs over quantization-round-tripped
+  values — weight-only quantization, the W8A32 scheme).
+
+Compute routes by (infer_dtype, resolved fused mode):
+
+| dtype    | XLA (CPU serving)                | PALLAS / PALLAS_INTERPRET     |
+|----------|----------------------------------|-------------------------------|
+| bfloat16 | bf16 GEMMs, f32 logits           | fused bf16 dense+relu kernel  |
+| int8     | dequantized-at-build f32 GEMMs   | int8 MXU dense stack, dynamic |
+|          | (weights round-tripped via int8) | per-dispatch activation scales|
+
+prepare_inference() is the single entry point: it returns the prepared
+parameter pytree (device_put-able) plus a pure forward(params, x_u8) ->
+f32 logits the engine jits exactly like the training-precision one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+INFER_DTYPES = ("float32", "bfloat16", "int8")
+
+
+def quantize_channelwise(w) -> tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric int8 quantization of a dense (in,
+    out) or conv (kh, kw, cin, out) kernel: scale[j] = max|W[..., j]| /
+    127 (an all-zero channel gets scale 1.0 so dequant stays exact),
+    Wq = clip(round(W / scale), -127, 127). Returns (int8 values,
+    float32 per-channel scales)."""
+    w = np.asarray(w, dtype=np.float32)
+    if w.ndim < 2:
+        raise ValueError(
+            f"channelwise quantization wants a >=2-D kernel, got shape "
+            f"{w.shape}")
+    flat = w.reshape(-1, w.shape[-1])
+    scale = np.max(np.abs(flat), axis=0) / 127.0
+    scale = np.where(scale == 0.0, 1.0, scale).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize(q, scale) -> np.ndarray:
+    """The quantization round-trip's float side: q * scale, float32."""
+    return np.asarray(q, dtype=np.float32) * np.asarray(scale,
+                                                        dtype=np.float32)
+
+
+def quantize_act(h):
+    """Dynamic per-dispatch activation quantization (traced, static
+    shapes): one symmetric scale over the whole activation tensor.
+    Returns (int8 values, the f32 scalar scale)."""
+    import jax.numpy as jnp
+
+    s = jnp.maximum(jnp.max(jnp.abs(h)) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(h / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _mlp_weights(params) -> tuple:
+    """(w1, b1, w2, b2) from either MLP param layout: the nn.Dense tree
+    ({'hidden': {kernel, bias}}) or the fused-Pallas flat leaves
+    ({'hidden_kernel', 'hidden_bias'} — models/mlp.py)."""
+    if "hidden" in params:
+        w1, b1 = params["hidden"]["kernel"], params["hidden"]["bias"]
+    else:
+        w1, b1 = params["hidden_kernel"], params["hidden_bias"]
+    return (np.asarray(w1, np.float32), np.asarray(b1, np.float32),
+            np.asarray(params["logits"]["kernel"], np.float32),
+            np.asarray(params["logits"]["bias"], np.float32))
+
+
+def _center_pixels(x_u8):
+    """uint8 pixels -> int8 by centering at 128 (the int8 matmul's
+    operand range). The +128 offset term is linear in the weights, so
+    callers fold 128 * colsum(Wq) * scale into the layer bias at load —
+    the kernel never sees it."""
+    import jax.numpy as jnp
+
+    return (x_u8.astype(jnp.int32) - 128).astype(jnp.int8)
+
+
+def _prepare_mlp(params, infer_dtype: str, mode: str):
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.ops import fused
+
+    w1, b1, w2, b2 = _mlp_weights(params)
+    if infer_dtype == "bfloat16":
+        prep = {"w1": (w1 / 255.0).astype(jnp.bfloat16),
+                "b1": b1.astype(jnp.bfloat16),
+                "w2": w2.astype(jnp.bfloat16),
+                "b2": b2.astype(jnp.bfloat16)}
+
+        def forward(p, x_u8):
+            x = x_u8.reshape(x_u8.shape[0], -1).astype(jnp.bfloat16)
+            h = fused.dense_relu_inference(x, p["w1"], p["b1"], mode)
+            return (h @ p["w2"]).astype(jnp.float32) \
+                + p["b2"].astype(jnp.float32)
+
+        return prep, forward
+
+    q1, s1 = quantize_channelwise(w1)
+    q2, s2 = quantize_channelwise(w2)
+    if mode == fused.XLA:
+        # No fast integer GEMM on this route: bake the round-trip in at
+        # load and run f32 (weight-only quantization).
+        prep = {"w1": dequantize(q1, s1) / 255.0, "b1": b1,
+                "w2": dequantize(q2, s2), "b2": b2}
+
+        def forward(p, x_u8):
+            x = x_u8.reshape(x_u8.shape[0], -1).astype(jnp.float32)
+            h = fused.dense_relu_inference(x, p["w1"], p["b1"],
+                                           fused.XLA)
+            return h @ p["w2"] + p["b2"]
+
+        return prep, forward
+
+    # Pallas route: true int8 x int8 -> int32 dense stack. Pixels center
+    # to int8; the +128 offset folds into the first bias.
+    s1_eff = (s1 / 255.0).astype(np.float32)
+    b1_eff = (b1 + 128.0 * q1.astype(np.float32).sum(axis=0) * s1_eff)
+    prep = {"w1q": q1, "s1": s1_eff, "b1": b1_eff.astype(np.float32),
+            "w2q": q2, "s2": s2, "b2": b2}
+
+    def forward(p, x_u8):
+        x = _center_pixels(x_u8.reshape(x_u8.shape[0], -1))
+        h = fused.quant_dense(x, p["w1q"], p["s1"], p["b1"],
+                              relu=True, mode=mode)
+        hq, hs = quantize_act(h)
+        return fused.quant_dense(hq, p["w2q"], p["s2"] * hs, p["b2"],
+                                 relu=False, mode=mode)
+
+    return prep, forward
+
+
+def _prepare_lenet(params, infer_dtype: str, mode: str):
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.ops import fused
+    from distributedmnist_tpu.ops.conv import avg_pool2, im2col_conv
+
+    names = ("conv1", "conv2", "fc1", "fc2", "logits")
+    W = {n: np.asarray(params[n]["kernel"], np.float32) for n in names}
+    B = {n: np.asarray(params[n]["bias"], np.float32) for n in names}
+
+    if infer_dtype == "bfloat16":
+        prep = {n: {"kernel": (W[n] / (255.0 if n == "conv1" else 1.0))
+                    .astype(jnp.bfloat16),
+                    "bias": B[n].astype(jnp.bfloat16)} for n in names}
+    else:
+        # int8: every kernel quantized; the compute route below decides
+        # whether the int8 values or their round-tripped f32 side run.
+        # conv1's scales absorb the 1/255 input normalization.
+        prep = {}
+        for n in names:
+            q, s = quantize_channelwise(W[n])
+            if n == "conv1":
+                s = (s / 255.0).astype(np.float32)
+            prep[n] = {"q": q, "scale": s, "bias": B[n]}
+
+    quant_dense_stack = infer_dtype == "int8" and mode != fused.XLA
+    if infer_dtype == "int8":
+        # Convs always run as f32 patch matmuls over the round-tripped
+        # weights (pooling intermediates are float regardless); the
+        # dense stack is where the int8 MXU route lives.
+        for n in ("conv1", "conv2"):
+            prep[n]["kernel"] = dequantize(prep[n].pop("q"),
+                                           prep[n].pop("scale"))
+        if not quant_dense_stack:
+            for n in ("fc1", "fc2", "logits"):
+                prep[n]["kernel"] = dequantize(prep[n].pop("q"),
+                                               prep[n].pop("scale"))
+
+    act = jnp.bfloat16 if infer_dtype == "bfloat16" else jnp.float32
+    dense_mode = mode if infer_dtype == "bfloat16" else (
+        fused.XLA if not quant_dense_stack else mode)
+
+    def forward(p, x_u8):
+        x = x_u8.astype(act)                       # /255 folded in conv1
+        x = im2col_conv(x, p["conv1"]["kernel"], p["conv1"]["bias"],
+                        "SAME")
+        x = avg_pool2(jnp.maximum(x, 0).astype(act))
+        x = im2col_conv(x, p["conv2"]["kernel"], p["conv2"]["bias"],
+                        "VALID")
+        x = avg_pool2(jnp.maximum(x, 0).astype(act))
+        x = x.reshape(x.shape[0], -1)              # (B, 400)
+        if quant_dense_stack:
+            for n in ("fc1", "fc2"):
+                xq, xs = quantize_act(x)
+                x = fused.quant_dense(xq, p[n]["q"],
+                                      p[n]["scale"] * xs, p[n]["bias"],
+                                      relu=True, mode=mode)
+            xq, xs = quantize_act(x)
+            return fused.quant_dense(xq, p["logits"]["q"],
+                                     p["logits"]["scale"] * xs,
+                                     p["logits"]["bias"], relu=False,
+                                     mode=mode)
+        for n in ("fc1", "fc2"):
+            x = fused.dense_relu_inference(x, p[n]["kernel"],
+                                           p[n]["bias"], dense_mode)
+        out = x @ p["logits"]["kernel"] + p["logits"]["bias"]
+        return out.astype(jnp.float32)
+
+    return prep, forward
+
+
+def prepare_inference(model, params, infer_dtype: str,
+                      fused_mode: str) -> tuple[Any, Callable]:
+    """(prepared_params, forward) for the inference fast path.
+
+    `params` is the training-layout float32 param tree (host or device);
+    `infer_dtype` in {bfloat16, int8}; `fused_mode` a RESOLVED
+    ops.fused mode (resolve(cfg.fused_kernels, platform)). forward is a
+    pure function (prepared, x_u8) -> f32 logits, jit-ready with the
+    same signature as the training-precision engine forward. float32 is
+    refused by design: that precision serves the training-identical
+    reference forward, which is the engine's own default path."""
+    from distributedmnist_tpu import models
+    from distributedmnist_tpu.ops import fused
+
+    if infer_dtype == "float32":
+        raise ValueError(
+            "float32 serves the training-identical reference forward — "
+            "the inference fast path only exists for lower precisions")
+    if infer_dtype not in INFER_DTYPES:
+        raise ValueError(
+            f"unknown infer dtype {infer_dtype!r} (expected one of "
+            f"{INFER_DTYPES})")
+    if fused_mode not in (fused.XLA, fused.PALLAS,
+                          fused.PALLAS_INTERPRET):
+        raise ValueError(
+            f"fused_mode must be RESOLVED (ops.fused.resolve), got "
+            f"{fused_mode!r}")
+    import jax
+
+    params = jax.tree.map(np.asarray, params)
+    if isinstance(model, models.MLP):
+        return _prepare_mlp(params, infer_dtype, fused_mode)
+    if isinstance(model, models.LeNet5):
+        return _prepare_lenet(params, infer_dtype, fused_mode)
+    raise ValueError(
+        f"no inference fast path for model {type(model).__name__}; "
+        "teach serve/quantize.py its layer structure first")

@@ -51,6 +51,67 @@ log = logging.getLogger("distributedmnist_tpu")
 # did not reach the compiled-everywhere bar).
 STATES = ("warming", "ready", "live", "failed")
 
+# The serve-side accuracy-parity gate (ISSUE 7): per-dtype thresholds a
+# low-precision variant must clear against the float32 reference on the
+# held-out batch before it may EVER take traffic — (min argmax
+# agreement, max relative logit diff; utils/numerics.parity_check).
+# Values and their measured headroom are documented in PARITY.md
+# ("Serving parity gate"): bf16 carries ~0.4% relative mantissa error,
+# int8 per-channel weight quantization ~2-4% worst-case relative logit
+# error on this repo's models — the thresholds sit ~4-10x above the
+# honest error and far below a broken variant's (wrong scales land at
+# relative error O(1)).
+PARITY_GATES = {"bfloat16": (0.995, 0.05), "int8": (0.995, 0.15)}
+
+# Rows in the held-out parity batch (capped at the engine's max_batch):
+# deterministic calibrated-synthetic test images, the same distribution
+# the smoke gate's accuracy floor runs on.
+PARITY_ROWS = 128
+PARITY_SEED = 709
+
+
+@dataclasses.dataclass
+class VariantInfo:
+    """One low-precision engine set of a version (ISSUE 7): the same
+    params served through the serve/quantize.py fast path in
+    `infer_dtype`. Lifecycle mirrors the version's (warming -> ready,
+    or terminal failed) with one extra bar: the accuracy-parity gate —
+    a variant that compiles everywhere but disagrees with the f32
+    reference is REFUSED, its last_error says why, and promote() will
+    never route it."""
+
+    infer_dtype: str
+    state: str = "warming"
+    engines: list = dataclasses.field(default_factory=list)
+    engine: Any = None             # replica 0's engine (None until warm)
+    warmup_compile_events: int = 0
+    warmup_s: float = 0.0
+    loaded_at: float = 0.0
+    parity: Optional[dict] = None  # utils.numerics.parity_check record
+    last_error: Optional[str] = None
+    last_error_at: Optional[float] = None
+
+    def record_error(self, error: str) -> None:
+        self.last_error = error
+        self.last_error_at = time.time()
+
+    def describe(self) -> dict:
+        return {
+            "infer_dtype": self.infer_dtype,
+            "state": self.state,
+            "warmup_compile_events": self.warmup_compile_events,
+            "warmup_s": round(self.warmup_s, 3),
+            "parity": self.parity,
+            "last_error": self.last_error,
+            "last_error_at": (round(self.last_error_at, 3)
+                              if self.last_error_at is not None else None),
+            "bucket_cost_ms": ({
+                str(b): round(c * 1e3, 3)
+                for b, c in sorted(self.engine.bucket_costs().items())}
+                if self.engine is not None else None),
+            "replica_engines": len(self.engines),
+        }
+
 
 @dataclasses.dataclass
 class ModelVersion:
@@ -75,6 +136,11 @@ class ModelVersion:
     # residents with last_error None.
     last_error: Optional[str] = None
     last_error_at: Optional[float] = None
+    # Low-precision engine sets of THIS version's params, keyed by
+    # infer_dtype (ISSUE 7). The float32 base is `engines` above, not an
+    # entry here; a variant only appears after add_variant() warmed it
+    # and it either cleared or failed the parity gate.
+    variants: dict = dataclasses.field(default_factory=dict)
 
     def record_error(self, error: str) -> None:
         self.last_error = error
@@ -102,6 +168,13 @@ class ModelVersion:
             # one warmed engine per fleet replica; 1 on a single-router
             # registry, 0 while warming/failed
             "replica_engines": len(self.engines),
+            # the base engines' serving precision (the parity oracle)
+            "infer_dtype": (self.engine.infer_dtype
+                            if self.engine is not None else None),
+            # low-precision variants of this version: state, parity
+            # verdict, per-dtype cost table, refusal reason (ISSUE 7)
+            "variants": {dt: v.describe()
+                         for dt, v in sorted(self.variants.items())},
         }
 
 
@@ -128,11 +201,15 @@ class EngineFactory:
 
     def __init__(self, model, mesh, dtype=None, max_batch: int = 512,
                  buckets: Optional[Sequence[int]] = None,
-                 replicas: int = 1):
+                 replicas: int = 1, fused: str = "auto"):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.model = model
         self.replicas = replicas
+        # The fused-kernel mode every engine of this factory resolves
+        # against its mesh's platform (cfg.fused_kernels): the Pallas
+        # hot-op route for the quantized fast path on TPU, XLA on CPU.
+        self.fused = fused
         devices = list(mesh.devices.flat)
         if replicas > 1 and len(devices) >= replicas \
                 and len(devices) % replicas == 0:
@@ -174,11 +251,13 @@ class EngineFactory:
                           per_replica_inflight=per_replica_inflight,
                           hedge=hedge)
 
-    def make_engine(self, params, version: str,
-                    replica: int = 0) -> InferenceEngine:
+    def make_engine(self, params, version: str, replica: int = 0,
+                    infer_dtype: str = "float32") -> InferenceEngine:
         return InferenceEngine(self.model, params, self.meshes[replica],
                                dtype=self.dtype, max_batch=self.max_batch,
-                               buckets=self.buckets, version=version)
+                               buckets=self.buckets, version=version,
+                               infer_dtype=infer_dtype,
+                               fused_mode=self.fused)
 
     def init_params(self, seed: int = 0):
         """Fresh-init params (load harnesses and gates measure plumbing
@@ -440,16 +519,221 @@ class ModelRegistry:
                     "(%s stays ready)", live, mv.version)
         return mv
 
+    # -- dtype variants (ISSUE 7) ------------------------------------------
+
+    def _parity_batch(self) -> np.ndarray:
+        """The held-out gate batch: deterministic calibrated-synthetic
+        test images (the smoke gate's distribution), capped at the
+        engine geometry's max_batch so one infer() covers it."""
+        from distributedmnist_tpu.data import synthetic_mnist
+
+        rows = min(PARITY_ROWS, self.factory.max_batch)
+        data = synthetic_mnist(seed=PARITY_SEED, train_n=16, test_n=rows)
+        return np.asarray(data["test_x"][:rows])
+
+    def add_variant(self, version: str, infer_dtype: str,
+                    min_agreement: Optional[float] = None,
+                    max_rel_diff: Optional[float] = None) -> VariantInfo:
+        """Warm a low-precision engine set for `version` and gate it.
+
+        Same bar as a new version (every replica compiled everywhere,
+        zero residual compile events on the verification pass) PLUS the
+        accuracy-parity gate: the held-out batch runs through the f32
+        reference engine and the candidate, and the variant is REFUSED —
+        state 'failed', last_error naming the failing threshold, never
+        promotable — unless argmax agreement and the relative logit diff
+        clear the per-dtype thresholds (PARITY_GATES / PARITY.md).
+        Idempotent per (version, dtype): an already-ready variant
+        returns as-is — unless caller-supplied thresholds are passed,
+        in which case its existing engines are RE-GATED at that bar
+        (never silently judged at the looser default); a failed one may
+        be retried."""
+        from distributedmnist_tpu.utils import parity_check
+
+        if infer_dtype not in PARITY_GATES:
+            raise ValueError(
+                f"unknown variant dtype {infer_dtype!r} (expected one "
+                f"of {sorted(PARITY_GATES)}; float32 is the base)")
+        gate_agree, gate_rel = PARITY_GATES[infer_dtype]
+        if min_agreement is not None:
+            gate_agree = min_agreement
+        if max_rel_diff is not None:
+            gate_rel = max_rel_diff
+        with self._admin:
+            custom_gate = (min_agreement is not None
+                           or max_rel_diff is not None)
+            with self._state:
+                mv = self._get(version)
+                if mv.state not in ("ready", "live"):
+                    raise RuntimeError(
+                        f"version {version!r} is {mv.state!r}; variants "
+                        "hang off a warmed version")
+                existing = mv.variants.get(infer_dtype)
+                if existing is not None and existing.state == "ready" \
+                        and not custom_gate:
+                    return existing
+            if existing is not None and existing.state == "ready":
+                # Custom thresholds against an already-warm variant:
+                # RE-GATE the existing engines (no rebuild — they may
+                # be routed) instead of returning a verdict that was
+                # judged at the default bar. A failure records + bars
+                # future promotes exactly like a build-time refusal.
+                x = self._parity_batch()
+                parity = parity_check(mv.engines[0].infer(x),
+                                      existing.engines[0].infer(x),
+                                      min_agreement=gate_agree,
+                                      max_rel_diff=gate_rel)
+                existing.parity = parity
+                if not parity["passed"]:
+                    existing.state = "failed"
+                    existing.record_error(
+                        f"re-gate REFUSED {infer_dtype!r} variant of "
+                        f"{version!r}: {parity['why']}")
+                    # A refused variant must stop serving NOW, not at
+                    # the next operator promote: if it is the live
+                    # target, demote to the version's f32 base (event-
+                    # logged like a rollback — a precision demotion is
+                    # an incident an operator reconstructs after the
+                    # fact).
+                    live_dt = getattr(self.router, "live_infer_dtype",
+                                      lambda: None)()
+                    if (self.router.live_version() == version
+                            and live_dt == infer_dtype):
+                        self._route_set("live", mv)
+                        with self._state:
+                            self._events.append({
+                                "event": "variant_demoted",
+                                "version": version,
+                                "infer_dtype": infer_dtype,
+                                "to": "float32",
+                                "reason": existing.last_error,
+                                "at": round(time.time(), 3)})
+                        log.warning(
+                            "registry: live variant %s of %s demoted "
+                            "to float32 (%s)", infer_dtype, version,
+                            parity["why"])
+                    raise RuntimeError(existing.last_error)
+                return existing
+            with self._state:
+                vi = VariantInfo(infer_dtype=infer_dtype,
+                                 loaded_at=time.time())
+                mv.variants[infer_dtype] = vi
+            # Warmup + gate run OUTSIDE the state lock, same as add():
+            # /healthz and GET /models answer during the multi-second
+            # variant warm (it honestly shows state 'warming').
+            try:
+                t0 = time.perf_counter()
+                # Fault-injection seam: an injected variant failure
+                # drives the same refused-variant bookkeeping a real
+                # compile/parity failure would.
+                failpoint("registry.variant", version=version,
+                          dtype=infer_dtype)
+                engines = []
+                compile_events = 0
+                params = mv.engines[0].params   # the f32 base tree
+                for i in range(self.n_replicas):
+                    engine = self.factory.make_engine(
+                        params, version, replica=i,
+                        infer_dtype=infer_dtype)
+                    compile_events += engine.warmup()
+                    residual = engine.warmup()
+                    if residual:
+                        raise RuntimeError(
+                            f"variant {infer_dtype!r} of {version!r} "
+                            f"(replica {i}) still compiled {residual} "
+                            "time(s) on the verification warmup pass — "
+                            "refusing to mark it promotable")
+                    engines.append(engine)
+                # The accuracy-parity gate: f32 reference vs candidate
+                # on the held-out batch. A refusal is terminal for this
+                # build — the variant must never be silently served.
+                x = self._parity_batch()
+                parity = parity_check(mv.engines[0].infer(x),
+                                      engines[0].infer(x),
+                                      min_agreement=gate_agree,
+                                      max_rel_diff=gate_rel)
+                vi.parity = parity
+                if not parity["passed"]:
+                    raise RuntimeError(
+                        f"parity gate REFUSED {infer_dtype!r} variant "
+                        f"of {version!r}: {parity['why']}")
+                vi.engines = engines
+                vi.engine = engines[0]
+                vi.warmup_compile_events = compile_events
+                vi.warmup_s = time.perf_counter() - t0
+                vi.state = "ready"
+            except Exception as e:
+                vi.state = "failed"
+                vi.engines = []
+                vi.engine = None     # don't pin a refused engine's HBM
+                vi.record_error(f"{type(e).__name__}: {e}")
+                raise
+            log.info(
+                "registry: %s variant %s ready (%d compile events, "
+                "%.2fs warm; parity agree=%s rel_diff=%s)", version,
+                infer_dtype, vi.warmup_compile_events, vi.warmup_s,
+                vi.parity["argmax_agreement"],
+                vi.parity["max_rel_logit_diff"])
+            return vi
+
+    def cheapest_variant(self, version: str) -> str:
+        """The auto-pick rule: among the f32 base and this version's
+        parity-PASSING ready variants, the dtype whose warmup-measured
+        cost table prices the bucket ladder cheapest (sum over rungs —
+        every engine shares one ladder, so the sums are comparable).
+        Variants that failed the gate never compete."""
+        with self._state:
+            mv = self._get(version)
+            candidates = {"float32": mv.engines[0]}
+            for dt, vi in mv.variants.items():
+                if vi.state == "ready" and vi.engine is not None:
+                    candidates[dt] = vi.engine
+
+        def price(engine) -> float:
+            costs = engine.bucket_costs()
+            return sum(costs.values()) if costs else float("inf")
+
+        return min(candidates, key=lambda dt: price(candidates[dt]))
+
+    def activate_infer_dtype(self, version: str, choice: str) -> str:
+        """serve.py's --serve-infer-dtype driver: warm + gate the
+        requested variant(s) of `version`, then promote the pick.
+        choice 'auto' tries every gated dtype and promotes the cheapest
+        parity-passing one (possibly staying on float32); an explicit
+        dtype raises if its variant is refused — the caller keeps
+        serving f32 and the refusal is visible in GET /models. Returns
+        the dtype now live."""
+        targets = (list(PARITY_GATES) if choice == "auto" else [choice])
+        errors = {}
+        for dt in targets:
+            try:
+                self.add_variant(version, dt)
+            except Exception as e:
+                errors[dt] = e
+                log.warning("variant %s of %s refused: %s", dt, version,
+                            e)
+        if choice == "auto":
+            pick = self.cheapest_variant(version)
+        else:
+            if choice in errors:
+                raise errors[choice]
+            pick = choice
+        self.promote(version, infer_dtype=pick)
+        return pick
+
     # -- routing -----------------------------------------------------------
 
     def _route_set(self, kind: str, mv: ModelVersion,
-                   fraction: Optional[float] = None) -> None:
+                   fraction: Optional[float] = None,
+                   engines: Optional[list] = None) -> None:
         """One routing mutation, fanned out fleet-wide: a ReplicaSet
         takes the whole per-replica engine list under its pick lock (no
         batch dispatches mid-roll); a plain Router takes the single
-        engine — same call sites, no drift between the two shapes."""
-        target = (list(mv.engines) if self.n_replicas > 1
-                  else mv.engines[0])
+        engine — same call sites, no drift between the two shapes.
+        `engines` overrides the version's base engine list (a dtype
+        variant routing under the same version label)."""
+        engines = mv.engines if engines is None else engines
+        target = (list(engines) if self.n_replicas > 1 else engines[0])
         if kind == "live":
             self.router.set_live(target, mv.version)
         elif kind == "shadow":
@@ -457,18 +741,34 @@ class ModelRegistry:
         else:
             self.router.set_canary(target, mv.version, fraction)
 
-    def promote(self, version: str) -> ModelVersion:
+    def promote(self, version: str,
+                infer_dtype: Optional[str] = None) -> ModelVersion:
         """Atomic hot-swap: `version` (which must be warmed: 'ready' or
         already 'live') becomes the live target. The demoted version
-        stays resident in state 'ready' — rollback is promote(old)."""
+        stays resident in state 'ready' — rollback is promote(old).
+        `infer_dtype` routes one of the version's gated low-precision
+        variants instead of the f32 base ('float32'/None = base); a
+        variant that is not parity-passing ready is refused here too —
+        the gate has no promote-time bypass."""
         with self._admin, self._state:
             mv = self._get(version)
             if mv.state not in ("ready", "live"):
                 raise RuntimeError(
                     f"version {version!r} is {mv.state!r}; only a warmed "
                     "('ready') version may take live traffic")
+            engines = None
+            if infer_dtype not in (None, "float32"):
+                vi = mv.variants.get(infer_dtype)
+                if vi is None or vi.state != "ready" or not vi.engines:
+                    why = (vi.last_error if vi is not None
+                           else "never warmed")
+                    raise RuntimeError(
+                        f"variant {infer_dtype!r} of {version!r} is not "
+                        f"promotable ({why}); only a parity-passing "
+                        "ready variant may take traffic")
+                engines = vi.engines
             prev = self.router.live_version()
-            self._route_set("live", mv)
+            self._route_set("live", mv, engines=engines)
             mv.state = "live"
             if prev is not None and prev != version:
                 old = self._versions.get(prev)
@@ -586,10 +886,15 @@ class ModelRegistry:
         # _state only — never blocked by an in-flight warmup, so
         # /healthz and GET /models answer during a multi-second load
         with self._state:
+            live_dtype = getattr(self.router, "live_infer_dtype",
+                                 lambda: None)()
             return {
                 "versions": [mv.describe()
                              for mv in self._versions.values()],
                 "routes": self.router.routes(),
+                # which precision the LIVE engines actually serve
+                # (ISSUE 7 satellite: an operator must be able to tell)
+                "live_infer_dtype": live_dtype,
                 "events": list(self._events),
                 "max_versions": self.max_versions,
                 "checkpoint_dir": self.checkpoint_dir,
@@ -638,7 +943,8 @@ def build_serving(cfg, metrics=None):
     model, mesh, dtype = build_model_and_mesh(cfg)
     factory = EngineFactory(model, mesh, dtype=dtype,
                             max_batch=cfg.serve_max_batch,
-                            replicas=cfg.serve_replicas)
+                            replicas=cfg.serve_replicas,
+                            fused=cfg.fused_kernels)
     if cfg.serve_replicas > 1:
         router = factory.make_fleet(
             metrics=metrics, seed=cfg.seed,

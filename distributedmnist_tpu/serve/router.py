@@ -80,6 +80,10 @@ class RoutedHandle:
     shadow_handle: Any = None
     shadow_engine: Any = None
     shadow_version: Optional[str] = None
+    # The serving precision of the engine that computed this batch
+    # (ISSUE 7): rides the handle to metrics exactly like version, so
+    # per-dtype populations are attributable end to end.
+    infer_dtype: Optional[str] = None
     # The fleet replica this router belongs to (ISSUE 6): dispatch now
     # targets (version, replica), and the tag rides the handle end to
     # end so metrics can attribute each batch to the replica that
@@ -198,6 +202,16 @@ class Router:
         with self._lock:
             return self._live.version if self._live else None
 
+    def live_infer_dtype(self) -> Optional[str]:
+        """The live engine's serving precision (None while warming or
+        for engine-shaped doubles without one) — the /healthz and
+        GET /models 'which precision is live' surface (ISSUE 7)."""
+        with self._lock:
+            live = self._live
+        if live is None:
+            return None
+        return getattr(live.engine, "infer_dtype", None)
+
     def routes(self) -> dict:
         """The current routing table (for GET /models and tests)."""
         with self._lock:
@@ -261,7 +275,9 @@ class Router:
         h = target.engine.dispatch(x)
         rh = RoutedHandle(handle=h, engine=target.engine,
                           version=target.version, n=h.n, bucket=h.bucket,
-                          canary=is_canary, replica=self.replica)
+                          canary=is_canary, replica=self.replica,
+                          infer_dtype=getattr(target.engine,
+                                              "infer_dtype", None))
         # Shadow only duplicates LIVE-routed batches: the canary and
         # shadow populations stay disjoint, so their metrics are
         # separately attributable.

@@ -8,6 +8,14 @@ _EXPORTS = {
     "MetricsLogger": ("distributedmnist_tpu.utils.metrics", "MetricsLogger"),
     "StepTimer": ("distributedmnist_tpu.utils.metrics", "StepTimer"),
     "round_up": ("distributedmnist_tpu.utils.numerics", "round_up"),
+    "argmax_agreement": ("distributedmnist_tpu.utils.numerics",
+                         "argmax_agreement"),
+    "max_abs_diff": ("distributedmnist_tpu.utils.numerics",
+                     "max_abs_diff"),
+    "logit_parity": ("distributedmnist_tpu.utils.numerics",
+                     "logit_parity"),
+    "parity_check": ("distributedmnist_tpu.utils.numerics",
+                     "parity_check"),
     "enable_compilation_cache": (
         "distributedmnist_tpu.utils.compile_cache",
         "enable_compilation_cache"),

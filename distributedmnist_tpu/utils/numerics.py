@@ -1,4 +1,21 @@
-"""Small shared numeric helpers."""
+"""Small shared numeric helpers, including the logit-parity comparison
+the serving stack's accuracy gates run on.
+
+The parity helpers compare a CANDIDATE forward's logits against a
+REFERENCE forward's on the same batch — the shadow-router comparison
+(serve/router.py) and the registry's dtype-variant parity gate
+(serve/registry.py) both speak this vocabulary:
+
+- **argmax agreement**: the fraction of rows whose predicted class is
+  unchanged — the deployment-relevant signal (a served classifier's
+  OUTPUT is the argmax).
+- **max relative logit diff**: the worst absolute logit gap, normalized
+  by the reference batch's own logit magnitude. Absolute thresholds
+  don't transfer between a fresh-init model (logit spread ~0.05) and a
+  trained one (spread ~10), but low-precision arithmetic error scales
+  WITH the logits, so the relative form is the stable gate (PARITY.md
+  "Serving parity gate" documents the thresholds and their headroom).
+"""
 
 from __future__ import annotations
 
@@ -6,3 +23,68 @@ from __future__ import annotations
 def round_up(x: int, multiple: int) -> int:
     """Smallest multiple of `multiple` that is >= x."""
     return ((x + multiple - 1) // multiple) * multiple
+
+
+def argmax_agreement(ref, cand) -> float:
+    """Fraction of rows where argmax(ref) == argmax(cand); both (n, k)."""
+    import numpy as np
+
+    ref = np.asarray(ref)
+    cand = np.asarray(cand)
+    if ref.shape != cand.shape:
+        raise ValueError(
+            f"shape mismatch: reference {ref.shape} vs candidate "
+            f"{cand.shape}")
+    return float(np.mean(ref.argmax(-1) == cand.argmax(-1)))
+
+
+def max_abs_diff(ref, cand) -> float:
+    """Worst absolute elementwise gap between two logit arrays."""
+    import numpy as np
+
+    return float(np.max(np.abs(np.asarray(ref, dtype=np.float32)
+                               - np.asarray(cand, dtype=np.float32))))
+
+
+def logit_parity(ref, cand) -> dict:
+    """The full comparison record: agreement, absolute and relative
+    worst logit gaps, and the reference scale the relative form is
+    normalized by."""
+    import numpy as np
+
+    ref = np.asarray(ref, dtype=np.float32)
+    cand = np.asarray(cand, dtype=np.float32)
+    diff = max_abs_diff(ref, cand)
+    # The normalizer is the reference batch's own worst logit magnitude
+    # (floored so an all-zero reference can't divide by zero): error in
+    # low-precision arithmetic scales with the values themselves.
+    ref_scale = max(float(np.max(np.abs(ref))), 1e-6)
+    return {
+        "rows": int(ref.shape[0]),
+        "argmax_agreement": round(argmax_agreement(ref, cand), 6),
+        "max_abs_logit_diff": round(diff, 6),
+        "ref_logit_scale": round(ref_scale, 6),
+        "max_rel_logit_diff": round(diff / ref_scale, 6),
+    }
+
+
+def parity_check(ref, cand, min_agreement: float,
+                 max_rel_diff: float) -> dict:
+    """logit_parity plus the pass/fail verdict against the two gate
+    thresholds; `why` spells out the failing threshold(s) so a refusal's
+    last_error reads as a sentence, not a number dump."""
+    rep = logit_parity(ref, cand)
+    reasons = []
+    if rep["argmax_agreement"] < min_agreement:
+        reasons.append(
+            f"argmax agreement {rep['argmax_agreement']:.4f} < "
+            f"{min_agreement:.4f}")
+    if rep["max_rel_logit_diff"] > max_rel_diff:
+        reasons.append(
+            f"max relative logit diff {rep['max_rel_logit_diff']:.4f} > "
+            f"{max_rel_diff:.4f}")
+    rep["min_agreement"] = min_agreement
+    rep["max_rel_diff"] = max_rel_diff
+    rep["passed"] = not reasons
+    rep["why"] = "; ".join(reasons) if reasons else None
+    return rep

@@ -38,9 +38,12 @@ Two modes:
                          pre-warm every bucket OFF the hot path; the
                          new version becomes promotable, NOT live
     POST /models/promote {"version": str, "mode"?: "live"|"shadow"|
-                         "canary", "fraction"?: float} — atomic
-                         hot-swap (live), or route a fraction as
-                         shadow (compare + discard) / canary (real)
+                         "canary", "fraction"?: float,
+                         "infer_dtype"?: str} — atomic hot-swap
+                         (live; infer_dtype routes a parity-gated
+                         bf16/int8 variant instead of the f32 base),
+                         or route a fraction as shadow (compare +
+                         discard) / canary (real)
     POST /replicas/{id}/drain    take one fleet replica out of the
                          dispatch pick set (in-flight work finishes;
                          version rolls still fan out to it)
@@ -80,6 +83,17 @@ live version and auto-promotes the newest healthy resident, emitting a
 rollback event visible in /healthz and GET /models. --serve-faults
 installs a deterministic fault-injection schedule (serve/faults.py) for
 chaos drills; without it every woven failpoint is inert.
+
+Inference fast path (ISSUE 7, serve/quantize.py): --serve-infer-dtype
+{float32,bfloat16,int8,auto} picks the serving precision. float32 is the
+training-identical reference forward; bfloat16/int8 run the quantized +
+fused inference path, which takes traffic only after the registry's
+zero-compile prove-it pass AND an accuracy-parity gate against the f32
+reference (argmax agreement >= 0.995 + relative logit diff thresholds,
+PARITY.md); a refused variant stays off traffic with its reason in
+GET /models. auto serves the cheapest parity-passing variant by the
+warmup-measured bucket cost tables. /healthz and GET /models report
+live_infer_dtype so an operator can tell which precision is live.
 
 Replica fleet (ISSUE 6, serve/fleet.py): --serve-replicas N puts N
 engine replicas (mesh slices when devices divide evenly, logical
@@ -174,6 +188,7 @@ class ServerState:
             phase = self.phase
         ok = phase == "running" and live is not None
         import datetime
+        desc = registry.describe()
         payload = {
             "ok": ok,
             "state": phase,
@@ -182,9 +197,15 @@ class ServerState:
                 datetime.timezone.utc).isoformat(timespec="seconds"),
             "uptime_s": round(time.time() - self.started_at, 3),
             "live_version": live,
+            # which precision the live engines serve (ISSUE 7
+            # satellite): float32 reference vs a gated bf16/int8
+            # variant — None while warming. The registry's describe()
+            # already computes it getattr-safely (test doubles lack the
+            # field; .get keeps them working).
+            "live_infer_dtype": desc.get("live_infer_dtype"),
             "pending_rows": batcher.pending_rows(),
             "inflight_batches": batcher.inflight_batches(),
-            "versions": len(registry.describe()["versions"]),
+            "versions": len(desc["versions"]),
             "rollbacks": len(rollbacks),
             "last_rollback": attempts[-1] if attempts else None,
         }
@@ -250,7 +271,8 @@ def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
 
 def _http_serve(batcher, metrics, registry, state, port: int,
                 metrics_every: float, request_timeout: float,
-                warm, retry_after_cap_s: float = 30.0) -> dict:
+                warm, retry_after_cap_s: float = 30.0,
+                infer_dtype_choice: str = "float32") -> dict:
     import concurrent.futures
     import math
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -437,10 +459,29 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 self._send(400, {"error": "'fraction' must be a number, "
                                           f"got {body.get('fraction')!r}"})
                 return
+            # Optional serving precision for a live promote (ISSUE 7):
+            # route one of the version's parity-gated variants instead
+            # of the f32 base. Validated against the known dtypes here
+            # (400); an unwarmed/refused variant is a rule conflict
+            # below (409).
+            infer_dtype = body.get("infer_dtype")
+            if infer_dtype is not None:
+                from distributedmnist_tpu.serve.quantize import \
+                    INFER_DTYPES
+                if mode != "live":
+                    self._send(400, {"error": "'infer_dtype' only "
+                                              "applies to mode 'live'"})
+                    return
+                if infer_dtype not in INFER_DTYPES:
+                    self._send(400, {"error": f"unknown infer_dtype "
+                                              f"{infer_dtype!r}; one of "
+                                              f"{list(INFER_DTYPES)}"})
+                    return
             try:
                 with admin_lock:
                     if mode == "live":
-                        mv = registry.promote(version)
+                        mv = registry.promote(version,
+                                              infer_dtype=infer_dtype)
                     elif mode == "shadow":
                         mv = registry.set_shadow(version, fraction)
                     else:
@@ -594,6 +635,24 @@ def _http_serve(batcher, metrics, registry, state, port: int,
             except Exception:
                 log.exception("SIGHUP reload failed; live version "
                               "unchanged")
+                return
+            # Re-activate the CONFIGURED precision on the new version
+            # (ISSUE 7): a routine checkpoint roll must not silently
+            # revert an int8 deployment to the f32 base — the new
+            # params re-gate from scratch, and a refusal leaves the new
+            # version serving f32 loudly (visible in GET /models).
+            if infer_dtype_choice != "float32":
+                try:
+                    with admin_lock:
+                        pick = registry.activate_infer_dtype(
+                            mv.version, infer_dtype_choice)
+                    log.info("SIGHUP reload: %s serving %s", mv.version,
+                             pick)
+                except Exception:
+                    log.exception(
+                        "SIGHUP reload: --serve-infer-dtype %s refused "
+                        "on %s; float32 stays live for it",
+                        infer_dtype_choice, mv.version)
 
         threading.Thread(target=run, name="serve-reload",
                          daemon=True).start()
@@ -704,6 +763,23 @@ def main(argv=None) -> int:
                  "events; live: %s", mv.version, mv.source,
                  time.perf_counter() - t0, mv.warmup_compile_events,
                  registry.live_version())
+        # The inference fast path (ISSUE 7): f32 is live and serving
+        # already; warming + parity-gating the requested low-precision
+        # variant(s) happens ON TOP, and the promote only lands if the
+        # gate passed. A refused variant leaves f32 serving — the
+        # refusal is loud here and visible per-variant in GET /models.
+        if cfg.serve_infer_dtype != "float32":
+            try:
+                pick = registry.activate_infer_dtype(
+                    mv.version, cfg.serve_infer_dtype)
+                log.info("inference fast path: %s is live (%s)", pick,
+                         "auto-picked" if cfg.serve_infer_dtype == "auto"
+                         else "requested")
+            except Exception:
+                log.exception(
+                    "--serve-infer-dtype %s refused; float32 stays "
+                    "live (see GET /models variants for the parity "
+                    "verdict)", cfg.serve_infer_dtype)
 
     try:
         if args.port is None:
@@ -716,7 +792,9 @@ def main(argv=None) -> int:
                                   args.port, args.metrics_every,
                                   args.request_timeout, warm,
                                   retry_after_cap_s=(
-                                      cfg.serve_retry_after_cap_s))
+                                      cfg.serve_retry_after_cap_s),
+                                  infer_dtype_choice=(
+                                      cfg.serve_infer_dtype))
     finally:
         batcher.stop()
     print(json.dumps(summary), flush=True)

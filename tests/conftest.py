@@ -38,6 +38,14 @@ def pytest_configure(config):
         "fleet: replica-fleet test (serve/fleet.py: health-tracked "
         "dispatch, failover, hedging, drain/rejoin); runs in tier-1 "
         "like chaos — the marker exists for `-m fleet` selection")
+    config.addinivalue_line(
+        "markers",
+        "quant: quantized/fused inference fast-path test "
+        "(serve/quantize.py, ops/fused.py inference epilogues, the "
+        "registry's dtype-variant parity gate); cheap and "
+        "deterministic, so quant tests run in tier-1 — `-m 'not slow'` "
+        "keeps them, `-m quant` selects just this suite "
+        "(scripts/tier1.sh notes the inclusion)")
 
 
 def committed_steps(ckpt_dir: str) -> list:

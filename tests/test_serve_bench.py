@@ -210,6 +210,77 @@ def test_bench_serve_baseline_device_kind_mismatch_refused(tmp_path):
     assert not out.stdout.strip(), "refusal must not emit a record"
 
 
+def test_bench_dtype_sweep_flags_validated():
+    """--dtype-sweep / --serve-infer-dtype are serve-only flags,
+    rejected elsewhere like every other --serve knob."""
+    out = _run_cli("bench.py", ["throughput", "--dtype-sweep"],
+                   timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("bench.py", ["smoke", "--serve-infer-dtype", "int8"],
+                   timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("bench.py", ["serve", "--serve-infer-dtype", "fp4"],
+                   timeout=60)
+    assert out.returncode == 2
+
+
+def test_bench_serve_baseline_dtype_mismatch_refused(tmp_path):
+    """An int8 record must not masquerade as an f32 win (ISSUE 7
+    satellite): same silicon, different serving precision — refused
+    with the same exit-4 semantics as cross-silicon, before any
+    measured phase."""
+    base = tmp_path / "BENCH_serve_r98.json"
+    base.write_text(json.dumps({
+        "metric": "serve_images_per_sec_per_chip", "value": 999.0,
+        "detail": {"host": {"device_kind": "cpu",
+                            "infer_dtype": "int8"},
+                   "recompiles_after_warmup": 0,
+                   "closed_loop": {"latency_ms": {"p99": 1.0}}}}))
+    out = _run_cli("bench.py",
+                   ["serve", "--baseline", str(base)] + SERVE_ARGS)
+    assert out.returncode == 4, (out.returncode, out.stderr[-500:])
+    assert "infer_dtype" in out.stderr and "int8" in out.stderr
+    assert not out.stdout.strip(), "refusal must not emit a record"
+
+
+@pytest.mark.quant
+def test_bench_serve_dtype_sweep_contract():
+    """`bench.py serve --dtype-sweep` (the acceptance-criteria
+    spelling): one record carrying f32/bf16/int8 closed-loop legs
+    back-to-back — per-dtype img/s/chip, the parity verdicts that
+    gated the variants, per-dtype bucket cost tables, zero recompiles
+    per dtype — plus the infer_dtype/fused provenance in detail.host."""
+    out = _run_cli("bench.py", [
+        "serve", "--inline", "--model", "lenet", "--dtype-sweep",
+        "--serve-duration", "0.4", "--serve-qps", "30",
+        "--serve-clients", "2", "--serve-max-batch", "8",
+        "--serve-max-wait-us", "2000", "--no-artifact"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip())
+    d = rec["detail"]
+    assert d["host"]["infer_dtype"] == "float32"      # headline engine
+    assert d["host"]["fused_kernels"] == "xla"        # resolved for CPU
+    sweep = d["dtype_sweep"]
+    legs = sweep["legs"]
+    assert set(legs) == {"float32", "bfloat16", "int8"}
+    for dt in ("float32", "bfloat16", "int8"):
+        leg = legs[dt]
+        assert "skipped" not in leg, (dt, leg)        # lenet gates pass
+        assert leg["img_s_chip"] > 0
+        assert leg["recompiles_after_warmup"] == 0
+        assert leg["bucket_cost_ms"]                  # per-dtype table
+        # the measured window really served THIS precision
+        assert set(leg["by_dtype"]) == {dt}
+    for dt in ("bfloat16", "int8"):
+        p = legs[dt]["parity"]
+        assert p["passed"] is True
+        assert p["argmax_agreement"] >= 0.995
+        assert sweep["speedup_vs_float32"][dt] is not None
+    assert sweep["best_dtype"] in ("bfloat16", "int8")
+    # variant warmups excluded, steady state shape-stable end to end
+    assert d["recompiles_after_warmup"] == 0
+
+
 def test_serve_request_timeout_flag_validated():
     out = _run_cli("serve.py", ["--request-timeout", "0"], timeout=60)
     assert out.returncode == 2

@@ -1,0 +1,456 @@
+"""Quantized + fused inference fast path (ISSUE 7): weight quantization
+bounds, fast-path-vs-reference parity for every (model, dtype, kernel
+mode), interpret-vs-XLA fused-op equivalence across the serve bucket
+ladder, the registry's dtype-variant parity gate (pass AND refuse
+paths), zero recompiles across promotes between engines of different
+dtypes, the scheduler re-pricing flip, and the staging-pool audit on the
+quantized fetch path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributedmnist_tpu import models
+from distributedmnist_tpu.ops import fused
+from distributedmnist_tpu.parallel import make_mesh
+from distributedmnist_tpu.serve import quantize as quantize_lib
+from distributedmnist_tpu.serve.engine import InferenceEngine
+from distributedmnist_tpu.serve.metrics import ServeMetrics
+from distributedmnist_tpu.serve.registry import (EngineFactory,
+                                                 ModelRegistry,
+                                                 PARITY_GATES)
+from distributedmnist_tpu.utils import CompileCounter, parity_check
+
+pytestmark = pytest.mark.quant
+
+
+# -- quantization ----------------------------------------------------------
+
+def test_quantize_channelwise_dense_roundtrip(rng):
+    w = rng.normal(size=(40, 12)).astype(np.float32)
+    q, s = quantize_lib.quantize_channelwise(w)
+    assert q.dtype == np.int8 and s.shape == (12,)
+    assert np.abs(q).max() <= 127
+    back = quantize_lib.dequantize(q, s)
+    # symmetric rounding: per-channel error bounded by half a step
+    assert np.all(np.abs(back - w) <= s / 2 + 1e-7)
+
+
+def test_quantize_channelwise_conv_and_zero_channel(rng):
+    w = rng.normal(size=(5, 5, 3, 8)).astype(np.float32)
+    w[..., 2] = 0.0                       # an all-zero output channel
+    q, s = quantize_lib.quantize_channelwise(w)
+    assert s.shape == (8,)
+    assert s[2] == 1.0                    # guarded scale, exact dequant
+    np.testing.assert_array_equal(quantize_lib.dequantize(q, s)[..., 2],
+                                  0.0)
+    with pytest.raises(ValueError, match=">=2-D"):
+        quantize_lib.quantize_channelwise(np.zeros(4, np.float32))
+
+
+def test_quantize_act_dynamic_scale():
+    h = jnp.asarray([[0.5, -2.0, 1.0]], jnp.float32)
+    q, s = quantize_lib.quantize_act(h)
+    assert q.dtype == jnp.int8
+    assert abs(float(s) - 2.0 / 127.0) < 1e-9
+    np.testing.assert_allclose(np.asarray(q, np.float32) * float(s),
+                               np.asarray(h), atol=float(s) / 2 + 1e-9)
+
+
+# -- fused inference ops: interpret vs XLA across the bucket ladder --------
+
+def test_fused_inference_equivalence_every_bucket_rung(rng):
+    """dense_relu_inference (f32 + bf16) and quant_dense (int8) must
+    agree between the Pallas-interpret kernel and the XLA reference at
+    EVERY rung of a serve bucket ladder — the shapes the engines
+    actually dispatch."""
+    from distributedmnist_tpu.serve import make_buckets
+
+    k, n = 40, 24
+    w = rng.normal(size=(k, n)).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    wq, ws = quantize_lib.quantize_channelwise(w)
+    for m in make_buckets(16, 1):                 # 1, 2, 4, 8, 16
+        x = rng.normal(size=(m, k)).astype(np.float32)
+        ref = np.asarray(fused.dense_relu_inference(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), fused.XLA))
+        got = np.asarray(fused.dense_relu_inference(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+            fused.PALLAS_INTERPRET))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        # bf16 operands through the same kernel
+        got16 = np.asarray(fused.dense_relu_inference(
+            jnp.asarray(x, jnp.bfloat16), jnp.asarray(w, jnp.bfloat16),
+            jnp.asarray(b, jnp.bfloat16), fused.PALLAS_INTERPRET))
+        ref16 = np.asarray(fused.dense_relu_inference(
+            jnp.asarray(x, jnp.bfloat16), jnp.asarray(w, jnp.bfloat16),
+            jnp.asarray(b, jnp.bfloat16), fused.XLA))
+        np.testing.assert_allclose(got16.astype(np.float32),
+                                   ref16.astype(np.float32),
+                                   rtol=0.05, atol=0.05)
+        # int8: integer accumulation is exact, epilogues must match
+        xq = rng.integers(-127, 128, (m, k)).astype(np.int8)
+        for relu in (True, False):
+            gi = np.asarray(fused.quant_dense(
+                jnp.asarray(xq), jnp.asarray(wq), jnp.asarray(ws),
+                jnp.asarray(b), relu=relu, mode=fused.PALLAS_INTERPRET))
+            ri = np.asarray(fused.quant_dense_reference(
+                jnp.asarray(xq), jnp.asarray(wq), jnp.asarray(ws),
+                jnp.asarray(b), relu=relu))
+            np.testing.assert_allclose(gi, ri, rtol=1e-6, atol=1e-6)
+
+
+def test_quant_dense_rejects_non_int8():
+    with pytest.raises(TypeError, match="int8"):
+        fused.quant_dense(jnp.zeros((2, 3), jnp.float32),
+                          jnp.zeros((3, 4), jnp.int8),
+                          jnp.ones(4), jnp.zeros(4))
+
+
+# -- the fast path vs the training-identical reference ---------------------
+
+def _reference_logits(model, params, x):
+    fwd = jax.jit(lambda p, xu: model.apply(
+        {"params": p}, xu.astype(jnp.float32) / 255.0))
+    return np.asarray(fwd(params, x))
+
+
+@pytest.mark.parametrize("name", ["mlp", "lenet"])
+@pytest.mark.parametrize("infer_dtype", ["bfloat16", "int8"])
+@pytest.mark.parametrize("mode", [fused.XLA, fused.PALLAS_INTERPRET])
+def test_fastpath_parity_vs_reference(name, infer_dtype, mode, rng):
+    """Every (model, dtype, kernel-route) fast path must track the
+    training-precision forward within the PARITY.md relative-diff
+    thresholds. LeNet additionally holds full argmax agreement on
+    fresh-init params; the fresh-init MLP's logit spread is so tight
+    that honest low-precision error flips a few percent of near-tie
+    argmaxes — exactly the case the registry gate exists to refuse
+    (tested below), so here the MLP asserts the diff bound plus a
+    loose agreement floor."""
+    model = models.build(name, dtype=jnp.float32, platform="cpu")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    x = rng.integers(0, 256, (32, 28, 28, 1)).astype(np.uint8)
+    ref = _reference_logits(model, params, x)
+    prep, fwd = quantize_lib.prepare_inference(model, params,
+                                               infer_dtype, mode)
+    got = np.asarray(jax.jit(fwd)(jax.device_put(prep), x))
+    assert got.dtype == np.float32                # logits always f32
+    _, max_rel = PARITY_GATES[infer_dtype]
+    rep = parity_check(ref, got, min_agreement=0.9,
+                       max_rel_diff=max_rel)
+    assert rep["max_rel_logit_diff"] <= max_rel, rep
+    if name == "lenet":
+        assert rep["argmax_agreement"] == 1.0, rep
+    else:
+        assert rep["argmax_agreement"] >= 0.9, rep
+
+
+def test_fastpath_handles_fused_pallas_mlp_param_layout(rng):
+    """The MLP built with the fused Pallas hidden layer stores flat
+    hidden_kernel/hidden_bias leaves instead of the nn.Dense subtree —
+    prepare_inference must read both layouts."""
+    model = models.build("mlp", dtype=jnp.float32, fused="pallas",
+                         platform="cpu")        # resolves to interpret
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    assert "hidden_kernel" in params            # the flat layout
+    prep, fwd = quantize_lib.prepare_inference(model, params, "int8",
+                                               fused.XLA)
+    x = rng.integers(0, 256, (4, 28, 28, 1)).astype(np.uint8)
+    assert np.asarray(jax.jit(fwd)(jax.device_put(prep), x)).shape \
+        == (4, 10)
+
+
+def test_prepare_inference_rejects_bad_inputs():
+    model = models.build("mlp", platform="cpu")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    with pytest.raises(ValueError, match="float32 serves"):
+        quantize_lib.prepare_inference(model, params, "float32",
+                                       fused.XLA)
+    with pytest.raises(ValueError, match="unknown infer dtype"):
+        quantize_lib.prepare_inference(model, params, "fp4", fused.XLA)
+    with pytest.raises(ValueError, match="RESOLVED"):
+        quantize_lib.prepare_inference(model, params, "int8", "auto")
+
+
+# -- engine level ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lenet_pair(eight_devices):
+    """A (float32 reference, int8 fast path) engine pair over the same
+    fresh-init LeNet params and one small bucket ladder."""
+    mesh = make_mesh(eight_devices[:1])
+    model = models.build("lenet", platform="cpu")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    f32 = InferenceEngine(model, params, mesh, max_batch=8)
+    q8 = InferenceEngine(model, params, mesh, max_batch=8,
+                         infer_dtype="int8")
+    f32.warmup()
+    q8.warmup()
+    return f32, q8
+
+
+def test_engine_int8_parity_and_tags(lenet_pair, rng):
+    f32, q8 = lenet_pair
+    assert f32.infer_dtype == "float32" and q8.infer_dtype == "int8"
+    assert q8.fused_mode == fused.XLA        # resolved for CPU serving
+    x = rng.integers(0, 256, (7, 28, 28, 1)).astype(np.uint8)
+    ref = f32.infer(x)
+    got = q8.infer(x)
+    rep = parity_check(ref, got, *PARITY_GATES["int8"])
+    assert rep["passed"], rep
+    # the dtype tag rides the handle end to end (metrics by_dtype)
+    h = q8.dispatch(x)
+    assert h.infer_dtype == "int8"
+    q8.fetch(h)
+
+
+def test_quantized_fetch_failure_recycles_staging(lenet_pair, rng):
+    """The staging-pool audit (ISSUE 7 satellite): the quantized path
+    must recycle its pooled buffer on fetch FAILURE exactly like the
+    f32 path — a fetch-fault storm against an int8 engine must not
+    bleed one buffer per failed batch (the PR 5 try/finally, pinned
+    for the fast path via the fault injector's engine.fetch point)."""
+    from distributedmnist_tpu.serve import faults
+    from distributedmnist_tpu.serve.faults import InjectedFault
+
+    _, q8 = lenet_pair
+    x = rng.integers(0, 256, (3, 28, 28, 1)).astype(np.uint8)
+    q8.infer(x)                              # settle the pool
+    before = q8.staging_buffers()
+    faults.install(faults.FaultInjector.from_spec("engine.fetch:p=1",
+                                                  seed=1))
+    try:
+        for _ in range(5):
+            with pytest.raises(InjectedFault):
+                q8.infer(x)
+    finally:
+        faults.uninstall()
+    assert q8.staging_buffers() == before    # success AND failure paths
+    assert q8.infer(x).shape == (3, 10)      # and the engine still serves
+
+
+# -- registry: the dtype-variant parity gate -------------------------------
+
+@pytest.fixture(scope="module")
+def lenet_registry(eight_devices):
+    """A bootstrapped single-replica LeNet registry with a tiny ladder
+    (module-scoped: LeNet bucket compiles are the slow part; the gate
+    tests share one warmed instance)."""
+    mesh = make_mesh(eight_devices[:1])
+    model = models.build("lenet", platform="cpu")
+    factory = EngineFactory(model, mesh, max_batch=4)
+    metrics = ServeMetrics()
+    router = factory.make_router(metrics=metrics)
+    registry = ModelRegistry(factory, router)
+    registry.bootstrap(seed=0)
+    return registry, router, metrics
+
+
+def test_variant_gate_passes_and_promotes(lenet_registry, rng):
+    """Acceptance: bf16 and int8 variants pass the default gate
+    (argmax agreement >= 0.995 + the PARITY.md relative-diff bar) on
+    the held-out batch, promote by dtype routes them, and GET /models'
+    describe() surfaces state + parity + live precision."""
+    registry, router, _ = lenet_registry
+    version = registry.live_version()
+    for dt in ("bfloat16", "int8"):
+        vi = registry.add_variant(version, dt)
+        assert vi.state == "ready"
+        assert vi.parity["passed"] is True
+        assert vi.parity["argmax_agreement"] >= 0.995
+        assert vi.parity["max_rel_logit_diff"] <= PARITY_GATES[dt][1]
+    # idempotent: a ready variant returns as-is, no rebuild
+    again = registry.add_variant(version, "int8")
+    assert again is registry.get(version).variants["int8"]
+
+    registry.promote(version, infer_dtype="int8")
+    assert router.live_infer_dtype() == "int8"
+    d = registry.describe()
+    assert d["live_infer_dtype"] == "int8"
+    vdesc = d["versions"][0]["variants"]
+    assert vdesc["int8"]["state"] == "ready"
+    assert vdesc["int8"]["parity"]["passed"] is True
+    assert vdesc["int8"]["bucket_cost_ms"]          # per-dtype table
+    registry.promote(version)                        # back to the base
+    assert router.live_infer_dtype() == "float32"
+
+
+def test_zero_recompiles_across_dtype_promotes(lenet_registry, rng):
+    """ISSUE 7 satellite: promotes BETWEEN engines of different dtypes
+    must stay steady-state recompile-free at every bucket — each
+    engine's jit cache keys on its own (already-warmed) program, so a
+    dtype roll can never cost a cold bucket."""
+    registry, router, _ = lenet_registry
+    version = registry.live_version()
+    registry.add_variant(version, "int8")
+    compiles = CompileCounter.instance()
+    before = compiles.snapshot()
+    for dt in ("int8", None, "int8", None):          # roll back and forth
+        registry.promote(version, infer_dtype=dt)
+        for b in registry.factory.buckets:
+            x = rng.integers(0, 256, (b, 28, 28, 1)).astype(np.uint8)
+            assert router.infer(x).shape == (b, 10)
+    assert compiles.snapshot() - before == 0
+
+
+def test_variant_gate_refuses_and_records(lenet_registry):
+    """A variant failing the gate is REFUSED: state failed, last_error
+    naming the threshold, promote(dtype) raises — never silently
+    served. (An impossible agreement bar forces the refusal without
+    needing a genuinely broken build.)"""
+    registry, router, _ = lenet_registry
+    version = registry.live_version()
+    registry.get(version).variants.pop("bfloat16", None)  # force rebuild
+    with pytest.raises(RuntimeError, match="parity gate REFUSED"):
+        registry.add_variant(version, "bfloat16", min_agreement=1.01)
+    vi = registry.get(version).variants["bfloat16"]
+    assert vi.state == "failed"
+    assert "argmax agreement" in vi.last_error
+    assert vi.last_error_at is not None
+    assert vi.engines == []                  # refused engines not pinned
+    with pytest.raises(RuntimeError, match="not promotable"):
+        registry.promote(version, infer_dtype="bfloat16")
+    assert router.live_infer_dtype() == "float32"    # traffic unmoved
+    # a retry may clear the failed entry (thresholds back to default)
+    vi = registry.add_variant(version, "bfloat16")
+    assert vi.state == "ready"
+    # custom thresholds against an ALREADY-ready variant re-gate its
+    # existing engines instead of returning the default-bar verdict
+    with pytest.raises(RuntimeError, match="re-gate REFUSED"):
+        registry.add_variant(version, "bfloat16", min_agreement=1.01)
+    assert registry.get(version).variants["bfloat16"].state == "failed"
+    vi = registry.add_variant(version, "bfloat16")   # default bar again
+    assert vi.state == "ready"
+    # a LIVE variant failing a re-gate is demoted to the f32 base
+    # immediately (event-logged) — a refused precision must stop
+    # serving now, not at the next operator promote
+    registry.promote(version, infer_dtype="bfloat16")
+    assert router.live_infer_dtype() == "bfloat16"
+    with pytest.raises(RuntimeError, match="re-gate REFUSED"):
+        registry.add_variant(version, "bfloat16", min_agreement=1.01)
+    assert router.live_infer_dtype() == "float32"
+    demotions = [e for e in registry.events()
+                 if e.get("event") == "variant_demoted"]
+    assert demotions and demotions[-1]["infer_dtype"] == "bfloat16"
+    vi = registry.add_variant(version, "bfloat16")   # clean slate again
+    assert vi.state == "ready"
+
+
+def test_variant_failpoint_drives_refusal(lenet_registry):
+    """The registry.variant failpoint: an injected variant failure runs
+    the same refused-variant bookkeeping a real compile/parity failure
+    would (chaos drills can target the fast-path rollout)."""
+    from distributedmnist_tpu.serve import faults
+    from distributedmnist_tpu.serve.faults import InjectedFault
+
+    registry, _, _ = lenet_registry
+    version = registry.live_version()
+    registry.get(version).variants.pop("bfloat16", None)
+    faults.install(faults.FaultInjector.from_spec(
+        "registry.variant:p=1,dtype=bfloat16", seed=2))
+    try:
+        with pytest.raises(InjectedFault):
+            registry.add_variant(version, "bfloat16")
+    finally:
+        faults.uninstall()
+    vi = registry.get(version).variants["bfloat16"]
+    assert vi.state == "failed" and "InjectedFault" in vi.last_error
+
+
+def test_unknown_variant_dtype_rejected(lenet_registry):
+    registry, _, _ = lenet_registry
+    with pytest.raises(ValueError, match="unknown variant dtype"):
+        registry.add_variant(registry.live_version(), "float16")
+
+
+def test_auto_pick_serves_cheapest_parity_passing(lenet_registry):
+    """The --serve-infer-dtype auto rule: activate warms + gates every
+    variant and promotes the cheapest parity-passing one by the warmup
+    cost tables (float32 included as a candidate)."""
+    registry, router, _ = lenet_registry
+    version = registry.live_version()
+    pick = registry.activate_infer_dtype(version, "auto")
+    assert pick in ("float32", "bfloat16", "int8")
+    assert router.live_infer_dtype() == pick
+    mv = registry.get(version)
+    candidates = {"float32": mv.engines[0]}
+    candidates.update({dt: vi.engine for dt, vi in mv.variants.items()
+                       if vi.state == "ready"})
+    prices = {dt: sum(e.bucket_costs().values())
+              for dt, e in candidates.items()}
+    assert pick == min(prices, key=prices.get)
+    registry.promote(version)                        # restore the base
+
+
+def test_metrics_split_by_dtype(lenet_registry, rng):
+    """by_dtype attribution: batches served after a dtype promote land
+    in that precision's population."""
+    from distributedmnist_tpu.serve import DynamicBatcher
+
+    registry, router, metrics = lenet_registry
+    version = registry.live_version()
+    registry.add_variant(version, "int8")
+    metrics.reset()
+    batcher = DynamicBatcher(router, metrics=metrics).start()
+    try:
+        registry.promote(version, infer_dtype="int8")
+        batcher.submit(rng.integers(0, 256, (2, 784)).astype(np.uint8)
+                       ).result(timeout=60)
+        registry.promote(version)
+        batcher.submit(rng.integers(0, 256, (2, 784)).astype(np.uint8)
+                       ).result(timeout=60)
+    finally:
+        batcher.stop()
+    by_dtype = metrics.snapshot()["by_dtype"]
+    assert by_dtype["int8"]["rows"] == 2
+    assert by_dtype["float32"]["rows"] == 2
+
+
+# -- scheduler re-pricing ---------------------------------------------------
+
+def test_batch_former_replans_from_quantized_cost_table():
+    """ISSUE 7 acceptance: the PR 4 DP former demonstrably re-prices
+    when a cheaper per-row cost table (the quantized engine's) is
+    installed. Under the f32-shaped table (per-row compute dominates)
+    splitting a 20-row drain into 16+4 beats padding to 32; under a
+    table the fast path has flattened (same dispatch overhead, per-row
+    cost collapsed) the padding is nearly free and the SAME drain plans
+    as one covering dispatch — the split decision flips purely on the
+    installed table."""
+    from distributedmnist_tpu.serve.scheduler import plan_segments
+
+    buckets = (4, 8, 16, 32)
+    sizes = [4, 4, 4, 4, 4]                          # 20 rows
+    f32_table = {b: 0.001 + 0.004 * b for b in buckets}
+    quant_table = {b: 0.001 + 0.00001 * b for b in buckets}
+    split = plan_segments(sizes, buckets, f32_table)
+    assert len(split) == 2 and sum(split) == 5       # e.g. 4 + 16 rows
+    assert plan_segments(sizes, buckets, quant_table) == [5]
+
+
+# -- serve.py / healthz surface --------------------------------------------
+
+def test_healthz_reports_live_infer_dtype(lenet_registry):
+    import serve as serve_cli
+
+    registry, router, _ = lenet_registry
+
+    class _B:
+        controller = None
+
+        def pending_rows(self):
+            return 0
+
+        def inflight_batches(self):
+            return 0
+
+    state = serve_cli.ServerState()
+    code, payload = state.healthz(registry, _B())
+    assert code == 200
+    assert payload["live_infer_dtype"] == router.live_infer_dtype()
