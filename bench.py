@@ -53,6 +53,8 @@ import os
 import sys
 import time
 
+from distributedmnist_tpu.analysis.locks import make_thread
+
 TARGET_IPS_PER_CHIP = 2500.0
 TARGET_WALL_S = 30.0
 
@@ -84,7 +86,7 @@ def _barrier_marked(sync, every: float = 15.0) -> None:
         while not done.wait(every):
             _mark(f"waiting on device ({time.monotonic() - t0:.0f}s)")
 
-    t = threading.Thread(target=beat, daemon=True)
+    t = make_thread(target=beat, name="bench-barrier-beat", daemon=True)
     t.start()
     try:
         StepTimer.barrier(sync)
@@ -297,6 +299,22 @@ def main(argv=None) -> int:
             p.error("--serve-slo-ms must be > 0")
         if args.serve_replicas is not None and args.serve_replicas < 1:
             p.error("--serve-replicas must be >= 1")
+        if args.chaos:
+            # Validate the PROGRAMMATIC chaos schedules at argparse time
+            # (ISSUE 8 satellite): PR 5 gated user-typed --serve-faults
+            # specs in serve.py, but the bench builds its own specs from
+            # code — a failpoint-name typo there would die minutes into
+            # the run (or worse, silently inject nothing pre-PR 5
+            # hardening). Both template shapes (single-engine and
+            # fleet replica-kill) are exercised with placeholder ids;
+            # the runtime fills in the real live version / replica.
+            from distributedmnist_tpu.serve.faults import parse_spec
+            for template in (chaos_fault_spec("v0", None),
+                             chaos_fault_spec("v0", "r0")):
+                try:
+                    parse_spec(template)
+                except ValueError as e:
+                    p.error(f"chaos schedule template is invalid: {e}")
         if args.baseline is not None:
             # An unreadable/shapeless baseline is a usage error NOW; the
             # device_kind REFUSAL must wait for the backend (the worker
@@ -848,7 +866,8 @@ def _serve_closed_loop(batcher, metrics, reqs, clients: int,
                 client_errors.append(e)
                 return
 
-    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+    threads = [make_thread(target=client, args=(i,),
+                           name=f"bench-client-{i}", daemon=True)
                for i in range(clients)]
     for t in threads:
         t.start()
@@ -1188,6 +1207,34 @@ def _serve_dtype_sweep(registry, router, factory, metrics, make_batcher,
     return leg
 
 
+def chaos_fault_spec(live_version: str, kill_target) -> str:
+    """The chaos leg's programmatic fault schedule, in one place so the
+    argparse-time gate and the leg itself cannot drift (ISSUE 8
+    satellite: PR 5 validated user-typed specs at serve.py argparse;
+    this validates the bench's OWN constructed specs the same way —
+    main() runs both template shapes through faults.parse_spec before
+    any load phase).
+
+    - request-sticky poison on ~1.5% of dispatches (bisection's food),
+    - a fetch storm pinned to `live_version` after 40 clean batches
+      (the forced breaker trip; rollback un-matches the rule and ends
+      the storm, count=200 is the broken-rollback backstop),
+    - with `kill_target` (fleet runs): two small replica-kill bursts on
+      that replica — fetch-side then dispatch-side — timed to complete
+      BEFORE the version storm opens (overlapping them would kill a
+      rescue on the only sibling: unsurvivable at N=2 by construction,
+      and a different scenario from the replica fault class this storm
+      proves is absorbed)."""
+    spec = ("batch.dispatch:mode=request,p=0.015;"
+            f"engine.fetch:p=1,count=200,after=40,version={live_version}")
+    if kill_target is not None:
+        spec += (f";replica.fetch:p=1,replica={kill_target},"
+                 "after=2,count=4"
+                 f";replica.dispatch:p=1,replica={kill_target},"
+                 "after=8,count=4")
+    return spec
+
+
 def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
                      compiles, pipelined: int, duration: float,
                      qps: float) -> dict:
@@ -1254,34 +1301,18 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
     # is what ENDS the storm (the rule stops matching the new live
     # version); count=200 is only the backstop against a broken
     # rollback turning the leg into a total outage.
-    spec = ("batch.dispatch:mode=request,p=0.015;"
-            f"engine.fetch:p=1,count=200,after=40,version={live}")
-    # The replica-kill storm (ISSUE 6, fleet runs only): kill one
-    # replica — first at fetch (its in-flight batches die holding
-    # results), then at dispatch (it refuses new work) — via the
-    # per-replica ctx match, leaving its sibling healthy. Every killed
-    # batch must be RESCUED by failover redispatch (failovers > 0, zero
-    # replica faults surfacing as request errors), and the rescue
-    # dispatches reuse the sibling's compiled bucket programs, so the
-    # whole storm stays recompile-free. The kill windows (victim
-    # crossings 3-6 at fetch, 9-12 at dispatch — roughly overall
-    # batches 6-24, the victim serving ~half) deliberately complete
-    # BEFORE the version-pinned fetch storm opens at engine.fetch
-    # evaluation 41: overlapping them would kill a rescue of a
-    # version-storm batch ON the only sibling — unsurvivable with two
-    # replicas by construction, and a different scenario from the
-    # replica fault class this storm exists to prove is absorbed. The
-    # bursts are also small enough that the victim's breaker NEED not
+    # The schedule (chaos_fault_spec — shared with main()'s argparse
+    # gate): the replica-kill storm rides along on fleet runs only.
+    # Kill windows: victim crossings 3-6 at fetch (its in-flight
+    # batches die holding results), 9-12 at dispatch (it refuses new
+    # work) — roughly overall batches 6-24, the victim serving ~half.
+    # The bursts are small enough that the victim's breaker NEED not
     # trip for availability to hold — failover, not exclusion, is what
-    # this storm proves.
+    # the replica storm proves; rescue dispatches reuse the sibling's
+    # compiled bucket programs, so the whole storm stays recompile-free.
     fleet = router if getattr(router, "n_replicas", 1) > 1 else None
-    kill_target = None
-    if fleet is not None:
-        kill_target = fleet.replica_ids()[-1]
-        spec += (f";replica.fetch:p=1,replica={kill_target},"
-                 "after=2,count=4"
-                 f";replica.dispatch:p=1,replica={kill_target},"
-                 "after=8,count=4")
+    kill_target = fleet.replica_ids()[-1] if fleet is not None else None
+    spec = chaos_fault_spec(live, kill_target)
     inj = faults.install(faults.FaultInjector.from_spec(spec, seed=23))
     _mark(f"chaos: schedule {spec!r} (seed 23), {chaos_duration:.0f}s "
           f"open loop at qps={qps:g}, wait {wait_us}us, fallback "
@@ -1587,8 +1618,9 @@ def _serve_swap_window(registry, factory, batcher, metrics, req,
                 client_errors.append(e)
                 return
 
-    threads = [threading.Thread(target=client, daemon=True)
-               for _ in range(clients)]
+    threads = [make_thread(target=client, name=f"bench-swap-client-{i}",
+                           daemon=True)
+               for i in range(clients)]
     for t in threads:
         t.start()
     time.sleep(min(0.5, duration * 0.2))     # unmeasured ramp

@@ -1,0 +1,45 @@
+"""Concurrency sanitizer + project lint (ISSUE 8).
+
+The serving stack is a ~4.8k-LoC concurrent system whose invariants —
+lock acquisition order, nothing slow under a hot-path lock, every
+staging buffer recycled, every in-flight slot released — were enforced
+by reviewer vigilance through PRs 3-7 (each needed multiple post-review
+hardening rounds for the same recurring bug classes). This package
+enforces them mechanically, on every tier-1 run:
+
+- locks.py     the named Lock/RLock/Condition/Semaphore/Thread factory
+               every serve/ module constructs its primitives through.
+               With no sanitizer installed the factories return the
+               bare threading primitives (zero wrappers, zero cost);
+               installed, they return instrumented wrappers feeding the
+               sanitizer.
+- sanitize.py  the runtime sanitizer: a global lock-order graph with
+               cycle detection (potential deadlock), blocking-call-
+               under-lock detection (time.sleep / socket I/O / the
+               device->host sync while holding a hot-path lock), and
+               resource-balance accounting (staging-pool checkouts and
+               in-flight window slots must net to zero at drain).
+               Opt-in via install_sanitizer() or DMNIST_SANITIZE=1; a
+               conftest fixture turns it on for every serve test.
+- lint.py      the AST project lint (`python -m
+               distributedmnist_tpu.analysis`): codified rules from
+               past review findings, each with a rule ID, a file:line
+               report, and a pragma allowlist. Exits nonzero on
+               findings — scripts/lint.sh wires it before pytest in
+               scripts/tier1.sh.
+"""
+
+from distributedmnist_tpu.analysis.locks import (make_condition,  # noqa: F401
+                                                 make_lock, make_rlock,
+                                                 make_semaphore,
+                                                 make_thread)
+from distributedmnist_tpu.analysis.sanitize import (  # noqa: F401
+    Sanitizer, active_sanitizer, blocking, install_sanitizer,
+    resource_acquire, resource_release, uninstall_sanitizer)
+
+__all__ = [
+    "make_lock", "make_rlock", "make_condition", "make_semaphore",
+    "make_thread", "Sanitizer", "install_sanitizer",
+    "uninstall_sanitizer", "active_sanitizer", "blocking",
+    "resource_acquire", "resource_release",
+]

@@ -1,0 +1,8 @@
+"""`python -m distributedmnist_tpu.analysis` — run the project lint
+(scripts/lint.sh is the shell wrapper scripts/tier1.sh invokes)."""
+
+import sys
+
+from distributedmnist_tpu.analysis.lint import main
+
+sys.exit(main())

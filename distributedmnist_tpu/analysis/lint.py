@@ -1,0 +1,391 @@
+"""AST project lint (ISSUE 8): codified rules from the serving stack's
+recurring review findings, run as `python -m distributedmnist_tpu.analysis`
+(scripts/lint.sh wires it before pytest in scripts/tier1.sh).
+
+Every rule encodes a bug class a past PR shipped and a review round had
+to catch by hand; the lint makes the catch mechanical. Rules report
+`path:line RULE message` and the CLI exits 1 on any finding, 0 clean.
+
+Allowlist: a finding whose line (or the line above it) carries
+`# lint: allow[RULE] <reason>` is suppressed — the reason is REQUIRED
+(a bare pragma does not suppress; silent exemptions rot). Allowed
+findings are counted and printable with --show-allowed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Iterable, Optional
+
+# Rule registry: ID -> (summary, the historical bug it encodes).
+RULES = {
+    "DML001": (
+        "bare threading primitive in the serving stack",
+        "serve/ code must construct Lock/RLock/Condition/Semaphore via "
+        "analysis.locks.make_* so the sanitizer can name it in the "
+        "lock-order graph (PRs 3-6 each hand-audited lock nesting; a "
+        "bare primitive is invisible to the deadlock check)"),
+    "DML002": (
+        "bare threading.Thread in the serving stack",
+        "serve/, serve.py and bench.py must spawn via "
+        "analysis.locks.make_thread(name=..., daemon=...) — review "
+        "rounds repeatedly caught threads that forgot daemon=True and "
+        "stranded pytest at exit (the conftest thread-hygiene fixture's "
+        "bug class, moved to construction time)"),
+    "DML003": (
+        "failpoint name not in the faults.py registry",
+        "a typo'd failpoint/spec string silently injects NOTHING and a "
+        "chaos drill then 'proves' resilience it never exercised — "
+        "parse_spec rejects unknown names at runtime (PR 5 hardening); "
+        "this rule rejects them at lint time, including in tests and "
+        "the bench's programmatic chaos schedules"),
+    "DML004": (
+        "time.time() in serving/bench code",
+        "latency and elapsed-time math must use the monotonic clock: a "
+        "wall-clock step (NTP, manual set) corrupts every derived "
+        "latency/uptime/ordering value. Wall-clock display stamps are "
+        "fine — allowlist them with a reason"),
+    "DML005": (
+        "jax.jit outside engine/warmup construction paths",
+        "the zero-recompile serving contract holds because every "
+        "compiled program is built (and warmed) in engine.py/"
+        "quantize.py; a jit call anywhere else in serve/ is a "
+        "steady-state recompile hazard the compile-counter tests "
+        "cannot attribute"),
+    "DML006": (
+        "staging-pool recycle not inside a finally block",
+        "the PR 5 leak: engine.fetch recycled its pooled buffer only on "
+        "success, so a fetch-failure storm bled one buffer per failed "
+        "batch — every _staging_pool append must sit in try/finally"),
+}
+
+_PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
+_FAILPOINT_NAME_RE = re.compile(r"^[a-z_]+\.[a-z_]+$")
+# A string constant that LOOKS like a fault spec fragment: a failpoint
+# name, a colon, and at least one key=value — the shape bench's
+# programmatic chaos schedules concatenate.
+_SPEC_SHAPED_RE = re.compile(r"^;?[a-z_]+\.[a-z_]+:[^;]*=")
+
+_BARE_PRIMITIVES = frozenset(
+    ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"))
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str                     # repo-relative, posix separators
+    line: int
+    rule: str
+    message: str
+    allowed: bool = False
+    allow_reason: Optional[str] = None
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+# -- scopes ----------------------------------------------------------------
+
+def _in_serve_pkg(rel: str) -> bool:
+    return rel.startswith("distributedmnist_tpu/serve/")
+
+
+def _primitive_scope(rel: str) -> bool:
+    return _in_serve_pkg(rel) or rel == "serve.py"
+
+
+def _thread_scope(rel: str) -> bool:
+    return _in_serve_pkg(rel) or rel in ("serve.py", "bench.py")
+
+
+def _time_scope(rel: str) -> bool:
+    return _in_serve_pkg(rel) or rel in ("serve.py", "bench.py")
+
+
+def _jit_scope(rel: str) -> bool:
+    return (_in_serve_pkg(rel)
+            and os.path.basename(rel) not in ("engine.py", "quantize.py"))
+
+
+def _failpoint_scope(rel: str) -> bool:
+    return True
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _known_failpoints() -> frozenset:
+    from distributedmnist_tpu.serve.faults import KNOWN_FAILPOINTS
+
+    return frozenset(KNOWN_FAILPOINTS)
+
+
+def _docstring_nodes(tree: ast.AST) -> set:
+    """ids of Constant nodes that are docstrings (prose mentions of
+    failpoint names in docs are not spec strings)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            body = getattr(node, "body", [])
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                out.add(id(body[0].value))
+    return out
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _spec_segment_names(s: str) -> list:
+    """Failpoint names referenced by a spec-shaped string (possibly a
+    fragment of a larger concatenated/f-string spec)."""
+    names = []
+    for seg in s.split(";"):
+        seg = seg.strip()
+        if not seg or ":" not in seg:
+            continue
+        name = seg.partition(":")[0].strip()
+        if _FAILPOINT_NAME_RE.match(name):
+            names.append(name)
+    return names
+
+
+# -- the checker -----------------------------------------------------------
+
+def lint_source(text: str, rel: str) -> list:
+    """All findings for one file's source. `rel` is the repo-relative
+    posix path (it decides which rules apply). Pragma suppression is
+    applied by the caller via apply_allowlist (kept separate so tests
+    can assert raw findings)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 0, "DML000",
+                        f"file does not parse: {e.msg}")]
+    findings: list = []
+    docstrings = _docstring_nodes(tree)
+    known = _known_failpoints() if _failpoint_scope(rel) else frozenset()
+    # String constants already checked as failpoint/parse_spec call
+    # arguments — the generic spec-shaped scan skips them (ast.walk is
+    # breadth-first, so a Call is always visited before its children).
+    spec_arg_ids: set = set()
+
+    # finally-containment index for DML006: every node id located under
+    # some Try's finalbody.
+    in_finally: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    in_finally.add(id(sub))
+
+    for node in ast.walk(tree):
+        # DML001 / DML002: bare threading constructors.
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "threading"):
+                if func.attr in _BARE_PRIMITIVES and _primitive_scope(rel):
+                    findings.append(Finding(
+                        rel, node.lineno, "DML001",
+                        f"bare threading.{func.attr}() — use "
+                        "analysis.locks.make_"
+                        f"{func.attr.lower().replace('bounded', '')}"
+                        "(name) so the sanitizer can track it"))
+                elif func.attr == "Thread" and _thread_scope(rel):
+                    findings.append(Finding(
+                        rel, node.lineno, "DML002",
+                        "bare threading.Thread() — use "
+                        "analysis.locks.make_thread(target, name, "
+                        "daemon) (explicit daemon decision, sanitizer-"
+                        "registered)"))
+            # DML004: time.time() calls.
+            if (_time_scope(rel) and isinstance(func, ast.Attribute)
+                    and func.attr == "time"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "time"):
+                findings.append(Finding(
+                    rel, node.lineno, "DML004",
+                    "time.time() — use time.monotonic()/perf_counter() "
+                    "for any elapsed/latency/ordering math; allowlist "
+                    "pure wall-clock display stamps with a reason"))
+            # DML005: jax.jit outside engine/quantize.
+            if (_jit_scope(rel) and isinstance(func, ast.Attribute)
+                    and func.attr == "jit"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "jax"):
+                findings.append(Finding(
+                    rel, node.lineno, "DML005",
+                    "jax.jit outside serve/engine.py|quantize.py — "
+                    "compiled serving programs are built only in the "
+                    "engine/warmup construction path (steady-state "
+                    "recompile hazard)"))
+            # DML003 (call form): failpoint("name", ...) and
+            # parse_spec/from_spec("spec...").
+            cname = _call_name(func)
+            if known and cname == "failpoint" and node.args:
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    spec_arg_ids.add(id(arg))
+                    if arg.value not in known:
+                        findings.append(Finding(
+                            rel, node.lineno, "DML003",
+                            f"failpoint name {arg.value!r} is not in "
+                            "faults.KNOWN_FAILPOINTS — it would never "
+                            "fire"))
+            if known and cname in ("parse_spec", "from_spec") and node.args:
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    spec_arg_ids.add(id(arg))
+                    for name in _spec_segment_names(arg.value):
+                        if name not in known:
+                            findings.append(Finding(
+                                rel, node.lineno, "DML003",
+                                f"fault spec names unknown failpoint "
+                                f"{name!r} (known: would be rejected "
+                                "at install — fix the schedule)"))
+            # DML006: staging-pool recycle outside finally.
+            if (_in_serve_pkg(rel) and isinstance(func, ast.Attribute)
+                    and func.attr == "append"):
+                recv = func.value
+                if (isinstance(recv, ast.Subscript)
+                        and isinstance(recv.value, ast.Attribute)
+                        and recv.value.attr == "_staging_pool"
+                        and id(node) not in in_finally):
+                    findings.append(Finding(
+                        rel, node.lineno, "DML006",
+                        "staging-pool recycle outside a finally block — "
+                        "an error path here leaks one pooled buffer per "
+                        "failure (the PR 5 fetch-storm leak)"))
+        # DML003 (literal form): spec-shaped string constants anywhere
+        # outside docstrings — catches the bench's concatenated /
+        # f-string chaos schedules piece by piece.
+        if (known and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in docstrings
+                and id(node) not in spec_arg_ids
+                and _SPEC_SHAPED_RE.match(node.value.strip())):
+            for name in _spec_segment_names(node.value):
+                if name not in known:
+                    findings.append(Finding(
+                        rel, node.lineno, "DML003",
+                        f"spec-shaped literal names unknown failpoint "
+                        f"{name!r} — a schedule built from it would "
+                        "inject nothing"))
+    return findings
+
+
+def apply_allowlist(findings: list, lines: list) -> tuple:
+    """Split findings into (active, allowed) per the pragma on the
+    finding's line or the line above. A pragma without a reason does
+    NOT suppress."""
+    active, allowed = [], []
+    for f in findings:
+        reason = None
+        for ln in (f.line, f.line - 1):
+            if 1 <= ln <= len(lines):
+                m = _PRAGMA_RE.search(lines[ln - 1])
+                if m and m.group(1) == f.rule and m.group(2):
+                    reason = m.group(2).strip()
+                    break
+        if reason is not None:
+            f.allowed = True
+            f.allow_reason = reason
+            allowed.append(f)
+        else:
+            active.append(f)
+    return active, allowed
+
+
+def lint_file(path: str, rel: str) -> tuple:
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    findings = lint_source(text, rel)
+    return apply_allowlist(findings, text.splitlines())
+
+
+def iter_python_files(root: str) -> Iterable[tuple]:
+    """(abs_path, rel_posix) for every lintable .py under the repo:
+    the package, tests, scripts, and the top-level entry points."""
+    skip_dirs = {"__pycache__", ".git", ".claude"}
+    for base in ("distributedmnist_tpu", "tests", "scripts"):
+        top = os.path.join(root, base)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    yield p, os.path.relpath(p, root).replace(os.sep, "/")
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py"):
+            yield os.path.join(root, fn), fn
+
+
+def lint_paths(root: str) -> tuple:
+    active: list = []
+    allowed: list = []
+    for path, rel in iter_python_files(root):
+        a, ok = lint_file(path, rel)
+        active.extend(a)
+        allowed.extend(ok)
+    return active, allowed
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedmnist_tpu.analysis",
+        description="Project lint: serving-stack concurrency/correctness "
+                    "rules codified from past review findings. Exit 0 "
+                    "clean, 1 on findings, 2 on internal error.")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: this checkout)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--show-allowed", action="store_true",
+                   help="also print pragma-allowlisted findings")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (summary, why) in sorted(RULES.items()):
+            print(f"{rule}  {summary}\n        {why}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    try:
+        active, allowed = lint_paths(root)
+    except Exception as e:           # broken lint must not read as clean
+        print(f"lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    for f in sorted(active, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.format())
+    if args.show_allowed:
+        for f in sorted(allowed, key=lambda f: (f.path, f.line, f.rule)):
+            print(f"ALLOWED {f.format()}  [{f.allow_reason}]")
+    print(f"lint: {len(active)} finding(s), {len(allowed)} allowlisted",
+          file=sys.stderr)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,233 @@
+"""Named threading-primitive factory for the serving stack (ISSUE 8).
+
+Every lock, condition, semaphore and thread in serve/ is constructed
+through these factories instead of bare `threading.*` calls (the
+project lint's DML001/DML002 enforce it). The names are the nodes of
+the sanitizer's lock-order graph — "registry.state -> router.routes"
+is a meaningful invariant, "lock at 0x7f.. -> lock at 0x7f.." is not —
+and `blocking_ok=True` marks the deliberately-slow locks (the registry
+admin RLock serializes multi-second warmups BY DESIGN) that the
+blocking-under-lock check must not flag.
+
+With no sanitizer installed (every production process) each factory
+returns the bare threading primitive: no wrapper object exists, the
+hot path is bit-identical to pre-ISSUE-8 code. With one installed
+(tests' conftest fixture, or DMNIST_SANITIZE=1) the factories return
+thin instrumented wrappers whose acquire/release feed the sanitizer's
+per-thread held stack; the wrappers stay valid across uninstall (each
+hook re-checks the active sanitizer), so objects built under one test's
+sanitizer keep working inert in the next.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from distributedmnist_tpu.analysis import sanitize
+
+
+class _SanLock:
+    """Instrumented non-reentrant lock: threading.Lock plus sanitizer
+    bookkeeping on successful acquire / release."""
+
+    def __init__(self, name: str, blocking_ok: bool = False):
+        self._name = name
+        self._blocking_ok = blocking_ok
+        self._inner = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            san = sanitize.active_sanitizer()
+            if san is not None:
+                san.on_acquired(self._name, id(self), self._blocking_ok)
+        return ok
+
+    def release(self) -> None:
+        san = sanitize.active_sanitizer()
+        if san is not None:
+            san.on_released(self._name, id(self))
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<SanLock {self._name!r} {self._inner!r}>"
+
+
+class _SanRLock:
+    """Instrumented re-entrant lock. Only the OUTERMOST acquire/release
+    of a thread's hold touches the sanitizer (re-entry is not a new
+    edge — it is the same hold); depth is tracked per-thread."""
+
+    def __init__(self, name: str, blocking_ok: bool = False):
+        self._name = name
+        self._blocking_ok = blocking_ok
+        self._inner = threading.RLock()
+        self._tls = threading.local()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            depth = getattr(self._tls, "depth", 0)
+            self._tls.depth = depth + 1
+            if depth == 0:
+                san = sanitize.active_sanitizer()
+                if san is not None:
+                    san.on_acquired(self._name, id(self),
+                                    self._blocking_ok)
+        return ok
+
+    def release(self) -> None:
+        depth = getattr(self._tls, "depth", 0) - 1
+        self._tls.depth = depth
+        if depth == 0:
+            san = sanitize.active_sanitizer()
+            if san is not None:
+                san.on_released(self._name, id(self))
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # The threading.Condition lock protocol, delegated to the inner
+    # RLock: Condition.wait() releases ALL recursion levels and
+    # restores them on wake — the held-stack bookkeeping must mirror
+    # that, or the sanitizer would think a waiting thread still holds
+    # the lock. Production Condition() is RLock-backed, so sanitized
+    # conditions must be too: a reentrant condition-lock path that
+    # works in production must not silently deadlock under the
+    # sanitizer (the one failure shape this package must never cause).
+    def _release_save(self):
+        depth = getattr(self._tls, "depth", 0)
+        self._tls.depth = 0
+        if depth > 0:
+            san = sanitize.active_sanitizer()
+            if san is not None:
+                san.on_released(self._name, id(self))
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, saved) -> None:
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        self._tls.depth = depth
+        if depth > 0:
+            san = sanitize.active_sanitizer()
+            if san is not None:
+                san.on_acquired(self._name, id(self), self._blocking_ok)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def __repr__(self) -> str:
+        return f"<SanRLock {self._name!r} {self._inner!r}>"
+
+
+class _SanSemaphore:
+    """Instrumented semaphore doubling as a resource-balance counter:
+    every acquire checks one unit of `name` out, every release returns
+    it — at drain the sanitizer's balance for `name` must read zero
+    (the in-flight window slot contract the batcher relies on)."""
+
+    def __init__(self, name: str, value: int = 1):
+        self._name = name
+        self._inner = threading.Semaphore(value)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True,
+                timeout: float | None = None) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            sanitize.resource_acquire(self._name)
+        return ok
+
+    def release(self, n: int = 1) -> None:
+        san = sanitize.active_sanitizer()
+        if san is not None:
+            san.on_resource(self._name, -n)
+        self._inner.release(n)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<SanSemaphore {self._name!r} {self._inner!r}>"
+
+
+def make_lock(name: str, blocking_ok: bool = False):
+    """A named mutex: bare threading.Lock when no sanitizer is active,
+    an instrumented wrapper when one is. `blocking_ok=True` exempts
+    holders from the blocking-under-lock check (use for locks that
+    serialize slow work BY DESIGN, e.g. admin locks held across
+    warmups — never for anything the dispatch/completion path
+    crosses)."""
+    if sanitize.active_sanitizer() is None:
+        return threading.Lock()
+    return _SanLock(name, blocking_ok=blocking_ok)
+
+
+def make_rlock(name: str, blocking_ok: bool = False):
+    if sanitize.active_sanitizer() is None:
+        return threading.RLock()
+    return _SanRLock(name, blocking_ok=blocking_ok)
+
+
+def make_condition(name: str, blocking_ok: bool = False):
+    """A named condition variable. The sanitized variant wraps a
+    _SanRLock — the same reentrant semantics as a production
+    `threading.Condition()` (whose default lock is an RLock), so a
+    reentrant condition-lock path behaves identically sanitized and
+    not. wait() releases through the wrapper's Condition protocol
+    (_release_save/_acquire_restore), so the held-stack stays truthful
+    across waits at any recursion depth."""
+    if sanitize.active_sanitizer() is None:
+        return threading.Condition()
+    return threading.Condition(_SanRLock(name, blocking_ok=blocking_ok))
+
+
+def make_semaphore(name: str, value: int = 1):
+    """A named counting semaphore whose holds are resource-balanced by
+    the sanitizer (net zero at drain, never negative)."""
+    if sanitize.active_sanitizer() is None:
+        return threading.Semaphore(value)
+    return _SanSemaphore(name, value)
+
+
+def make_thread(target, name: str, daemon: bool, args: tuple = (),
+                kwargs: dict | None = None) -> threading.Thread:
+    """The registered thread constructor for serve/: `daemon` is a
+    REQUIRED argument — the PR 2-6 review rounds repeatedly caught
+    threads that forgot daemon=True and stranded pytest at exit, so
+    the choice must be written down at every spawn site. Under a
+    sanitizer the thread is registered for the leaked-non-daemon-thread
+    report."""
+    t = threading.Thread(target=target, name=name, args=args,
+                         kwargs=kwargs or {}, daemon=daemon)
+    san = sanitize.active_sanitizer()
+    if san is not None:
+        san.register_thread(t)
+    return t

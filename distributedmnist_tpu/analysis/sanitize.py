@@ -1,0 +1,399 @@
+"""Runtime concurrency sanitizer (ISSUE 8): lock-order graph, blocking-
+under-lock detection, and resource-balance accounting for the serving
+stack.
+
+Production pays nothing: the module-global active sanitizer is None by
+default (the serve/faults.py idiom), every hook is one attribute read +
+None test, and the lock factories in analysis/locks.py hand back bare
+threading primitives while nothing is installed. Installed (via
+install_sanitizer() or DMNIST_SANITIZE=1 at import), three checks run
+continuously:
+
+1. **Lock-order cycles.** Every sanitized lock acquisition records
+   "held -> acquired" edges (by lock NAME — the class-level order is
+   the invariant, instances of one name are one node) into a global
+   digraph; a new edge that closes a cycle is a potential deadlock
+   (thread 1 takes A then B while thread 2 takes B then A), recorded
+   with the full path. Nesting two same-named locks on one thread is
+   reported as a cycle too: with no defined order within the class,
+   two threads nesting opposite instances deadlock the same way.
+
+2. **Blocking under a hot lock.** time.sleep and socket connect/send/
+   recv are patched while installed, and engine.fetch's device->host
+   value sync calls the blocking() hook directly: any of these on a
+   thread holding a sanitized lock not marked blocking_ok is recorded
+   (the PR 3 bug class — warmup's multi-second compile under the
+   registry state lock starved /healthz — generalized). Slow-by-design
+   locks (the registry admin RLock, serve.py's admin lock) opt out
+   with make_lock(..., blocking_ok=True).
+
+3. **Resource balance.** Named counters fed by resource_acquire/
+   resource_release: the engine's staging-pool checkout/recycle and
+   the batcher's in-flight window semaphore must net to zero once the
+   pipeline drains (the PR 5 try/finally leak class — a fetch-failure
+   storm bleeding one pooled buffer per failed batch — asserted
+   automatically at the end of every serve test). A counter going
+   negative (release without acquire) is recorded immediately.
+
+Findings are RECORDED, not raised at the detection site: raising inside
+someone else's acquire() would corrupt the very pipeline under test.
+The conftest autouse fixture calls report()/assert_clean() after each
+serve test and fails the test on any finding.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+
+class Sanitizer:
+    """One installed sanitizer: the lock-order graph, the finding lists,
+    the resource counters, and the per-thread held-lock stacks. All
+    internal state is guarded by a single raw mutex (never a sanitized
+    lock — the sanitizer must not observe itself)."""
+
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._tls = threading.local()
+        # name -> set of names acquired while holding it (the order
+        # digraph); edges, cycles and findings dedupe on stable keys so
+        # a hot loop cannot flood the report.
+        self._order: dict[str, set] = {}
+        self._cycles: list[dict] = []
+        self._cycle_keys: set = set()
+        self._blocking: list[dict] = []
+        self._blocking_keys: set = set()
+        self._resources: dict[str, int] = {}
+        self._resource_errors: list[dict] = []
+        self._threads: list = []       # make_thread-registered threads
+
+    # -- per-thread held stack ---------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def held_locks(self) -> list:
+        """Names of sanitized locks the CURRENT thread holds, outermost
+        first (diagnostics and tests)."""
+        return [name for (_, name, _) in self._stack()]
+
+    # -- lock hooks (called by analysis/locks.py wrappers) -----------------
+
+    def on_acquired(self, name: str, obj_id: int,
+                    blocking_ok: bool) -> None:
+        st = self._stack()
+        held = list(st)
+        st.append((obj_id, name, blocking_ok))
+        if not held:
+            return
+        thread = threading.current_thread().name
+        with self._mutex:
+            for hid, hname, _ in held:
+                if hid == obj_id:
+                    continue          # re-entrant hold of one instance
+                self._add_edge_locked(hname, name, thread)
+
+    def on_released(self, name: str, obj_id: int) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == obj_id:
+                del st[i]
+                return
+        # A release with no recorded acquire happens when the lock was
+        # taken before install (or by Condition internals): not a
+        # finding — the sanitizer only reasons about what it saw.
+
+    def _add_edge_locked(self, a: str, b: str, thread: str) -> None:
+        if a == b:
+            key = ("same-name", a)
+            if key not in self._cycle_keys:
+                self._cycle_keys.add(key)
+                self._cycles.append({
+                    "cycle": [a, a],
+                    "thread": thread,
+                    "detail": (f"two locks named {a!r} nested on one "
+                               "thread: no order is defined within the "
+                               "class, so two threads nesting opposite "
+                               "instances deadlock (AB/BA)")})
+            return
+        succ = self._order.setdefault(a, set())
+        if b in succ:
+            return
+        succ.add(b)
+        path = self._path_locked(b, a)
+        if path is not None:
+            cycle = [a] + path        # a -> b -> ... -> a (path ends at a)
+            key = frozenset(cycle)
+            if key not in self._cycle_keys:
+                self._cycle_keys.add(key)
+                self._cycles.append({
+                    "cycle": cycle,
+                    "thread": thread,
+                    "detail": ("lock-order cycle (potential deadlock): "
+                               + " -> ".join(cycle))})
+
+    def _path_locked(self, src: str, dst: str) -> Optional[list]:
+        """A path src -> ... -> dst in the order digraph, or None."""
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._order.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- blocking-call detection -------------------------------------------
+
+    def on_blocking(self, kind: str) -> None:
+        hot = [name for (_, name, ok) in self._stack() if not ok]
+        if not hot:
+            return
+        key = (kind, tuple(hot))
+        with self._mutex:
+            if key in self._blocking_keys:
+                return
+            self._blocking_keys.add(key)
+            self._blocking.append({
+                "kind": kind,
+                "locks": hot,
+                "thread": threading.current_thread().name,
+                "detail": (f"blocking call {kind!r} while holding "
+                           f"hot-path lock(s) {hot} — move the slow "
+                           "work outside the lock (the PR 3 "
+                           "warmup-under-state-lock class)")})
+
+    # -- resource balance --------------------------------------------------
+
+    def on_resource(self, name: str, delta: int) -> None:
+        # A negative balance is reported unconditionally: within one
+        # sanitizer's lifetime a release-without-acquire is always a
+        # double-release bug. (One known benign shape: a straggler
+        # daemon thread from a PREVIOUS test draining its last fetch
+        # against the next test's fresh sanitizer — but that can only
+        # happen after the previous test already failed its own drain
+        # assert, so the cascade never masks a green run.)
+        with self._mutex:
+            value = self._resources.get(name, 0) + delta
+            self._resources[name] = value
+            if value < 0:
+                self._resource_errors.append({
+                    "resource": name,
+                    "balance": value,
+                    "thread": threading.current_thread().name,
+                    "detail": (f"resource {name!r} released more times "
+                               "than acquired (balance went negative)")})
+
+    def balances(self) -> dict:
+        """Current net acquire-release count per resource. Every entry
+        must be zero once the pipeline is drained — nonzero at drain is
+        the PR 5 leak class (a checked-out staging buffer or held
+        window slot that no error path returns)."""
+        with self._mutex:
+            return dict(self._resources)
+
+    def wait_drained(self, timeout_s: float = 5.0,
+                     poll_s: float = 0.02) -> bool:
+        """Poll until every resource balance reads zero — the caller's
+        last future resolves BEFORE the completion/drain daemon threads
+        release their slots and recycle their buffers, so an immediate
+        snapshot can read a transient +1 as a leak. Returns True once
+        drained, False at the deadline (the one grace loop serve.py's
+        summary block, the conftest fixture, and tests all share)."""
+        deadline = time.monotonic() + timeout_s
+        while any(self.balances().values()):
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
+    # -- thread registry ---------------------------------------------------
+
+    def register_thread(self, t: threading.Thread) -> None:
+        with self._mutex:
+            # Prune completed threads as we go: a long-lived sanitized
+            # serve process spawns short-lived hedge/drain threads
+            # continuously, and an append-only list would hold every
+            # dead Thread object for the process lifetime.
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def leaked_threads(self) -> list:
+        """make_thread-spawned NON-daemon threads still alive — the
+        leak class the conftest thread-hygiene fixture fails on,
+        visible to the sanitizer's own report too."""
+        with self._mutex:
+            return [t for t in self._threads
+                    if t.is_alive() and not t.daemon]
+
+    # -- reporting ---------------------------------------------------------
+
+    def cycles(self) -> list:
+        with self._mutex:
+            return list(self._cycles)
+
+    def blocking_findings(self) -> list:
+        with self._mutex:
+            return list(self._blocking)
+
+    def resource_errors(self) -> list:
+        with self._mutex:
+            return list(self._resource_errors)
+
+    def report(self) -> dict:
+        with self._mutex:
+            return {
+                "cycles": list(self._cycles),
+                "blocking": list(self._blocking),
+                "resource_errors": list(self._resource_errors),
+                "balances": {k: v for k, v in self._resources.items()
+                             if v},
+                "leaked_threads": [t.name for t in self._threads
+                                   if t.is_alive() and not t.daemon],
+            }
+
+    def assert_clean(self) -> None:
+        """Raise AssertionError naming every finding (cycle paths,
+        blocking sites, nonzero balances). The drain contract: call
+        only after the pipeline has stopped."""
+        rep = self.report()
+        problems = []
+        for c in rep["cycles"]:
+            problems.append(f"lock-order cycle: {c['detail']}")
+        for b in rep["blocking"]:
+            problems.append(f"blocking under lock: {b['detail']}")
+        for e in rep["resource_errors"]:
+            problems.append(f"resource error: {e['detail']}")
+        for name, v in rep["balances"].items():
+            problems.append(
+                f"resource imbalance at drain: {name!r} nets {v:+d} "
+                "(leaked checkout or unreleased slot)")
+        if rep["leaked_threads"]:
+            problems.append(
+                f"leaked non-daemon thread(s): {rep['leaked_threads']}")
+        if problems:
+            raise AssertionError(
+                "concurrency sanitizer findings:\n  "
+                + "\n  ".join(problems))
+
+
+# The module-global active sanitizer. None (the default, every
+# production process) keeps all the woven hooks to one attribute read.
+_active: Optional[Sanitizer] = None
+
+# Patch bookkeeping: (original, our wrapper) per patched callable.
+# Uninstall restores ONLY if the current value is still our wrapper —
+# another layer (pytest monkeypatch, a test stub) patching over us must
+# not be clobbered by a blind restore. A skipped restore is safe: every
+# wrapper captures its original in its closure and goes inert (one
+# None-check) once no sanitizer is active.
+_patched_sleep = None     # (original, wrapper) | None
+_patched_socket = {}      # attr -> (original, wrapper)
+
+
+def active_sanitizer() -> Optional[Sanitizer]:
+    return _active
+
+
+def install_sanitizer() -> Sanitizer:
+    """Activate a fresh Sanitizer process-wide and patch time.sleep +
+    socket connect/sendall/recv with held-lock checks. Refuses to
+    stack (two half-reports would make neither trustworthy)."""
+    global _active, _patched_sleep
+    if _active is not None:
+        raise RuntimeError(
+            "a Sanitizer is already installed; uninstall_sanitizer() "
+            "first")
+    san = Sanitizer()
+    _active = san
+
+    real_sleep = time.sleep
+
+    def _checked_sleep(seconds):
+        s = _active
+        if s is not None:
+            # Stable kind — findings dedupe on (kind, held locks), and
+            # a backoff loop sleeping computed durations must collapse
+            # to ONE finding, not flood the report with one per value.
+            s.on_blocking("time.sleep")
+        return real_sleep(seconds)
+
+    _patched_sleep = (real_sleep, _checked_sleep)
+    time.sleep = _checked_sleep
+
+    def _patch_sock(attr):
+        real = getattr(socket.socket, attr)
+
+        def checked(self, *args, **kwargs):
+            s = _active
+            if s is not None:
+                s.on_blocking(f"socket.{attr}")
+            return real(self, *args, **kwargs)
+
+        _patched_socket[attr] = (real, checked)
+        setattr(socket.socket, attr, checked)
+
+    for attr in ("connect", "sendall", "recv"):
+        _patch_sock(attr)
+    return san
+
+
+def uninstall_sanitizer() -> None:
+    """Deactivate and restore the patched calls — but only where the
+    current value is still OUR wrapper (another layer's later patch
+    must survive; our wrapper under it is inert once _active is None).
+    Locks created while installed keep their wrappers but go inert the
+    same way (every hook re-checks the active sanitizer per call)."""
+    global _active, _patched_sleep
+    _active = None
+    if _patched_sleep is not None:
+        real, wrapper = _patched_sleep
+        if time.sleep is wrapper:
+            time.sleep = real
+        _patched_sleep = None
+    for attr, (real, wrapper) in list(_patched_socket.items()):
+        if getattr(socket.socket, attr, None) is wrapper:
+            setattr(socket.socket, attr, real)
+    _patched_socket.clear()
+
+
+# -- the woven hooks (all one None-check when uninstalled) ----------------
+
+def blocking(kind: str) -> None:
+    """Declare the caller is about to block (device->host sync, I/O):
+    a finding if any hot-path sanitized lock is held on this thread."""
+    s = _active
+    if s is not None:
+        s.on_blocking(kind)
+
+
+def resource_acquire(name: str) -> None:
+    """One unit of `name` checked out (staging buffer taken, window
+    slot claimed). Must be matched by resource_release before drain."""
+    s = _active
+    if s is not None:
+        s.on_resource(name, +1)
+
+
+def resource_release(name: str) -> None:
+    s = _active
+    if s is not None:
+        s.on_resource(name, -1)
+
+
+# Env-var opt-in (the "turn it on for this serve.py run" path — no code
+# change needed): DMNIST_SANITIZE=1 installs at first import, which
+# precedes every make_lock call since the factories import this module.
+if os.environ.get("DMNIST_SANITIZE", "").lower() in ("1", "true", "on",
+                                                     "yes"):
+    install_sanitizer()

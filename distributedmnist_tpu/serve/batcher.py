@@ -67,6 +67,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional
 
+from distributedmnist_tpu.analysis.locks import (make_condition, make_lock,
+                                                 make_semaphore, make_thread)
 from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.serve.resilience import DeadlineExceeded
 from distributedmnist_tpu.serve.scheduler import (AdaptiveController,
@@ -162,12 +164,15 @@ class DynamicBatcher:
                 max_inflight, getattr(engine, "platform", "cpu"))
         self._q: deque[_Request] = deque()
         self._rows = 0                   # pending rows, watermark basis
-        self._cond = threading.Condition()
+        self._cond = make_condition("batcher.queue")
         self._stop = False
         # The in-flight window: a slot is held from the moment a batch
         # is popped off the queue until its results have fanned out, so
         # dispatched-but-unresolved batches never exceed max_inflight.
-        self._slots = threading.Semaphore(self.max_inflight)
+        # Named semaphore: the sanitizer balance-checks slot holds
+        # (acquires minus releases must net zero at drain — ISSUE 8).
+        self._slots = make_semaphore("batcher.inflight_slots",
+                                     self.max_inflight)
         self._inflight = 0
         # DISPATCHED-but-unresolved segments only (each holds a window
         # slot, so this never exceeds max_inflight): the depth gauge
@@ -175,7 +180,7 @@ class DynamicBatcher:
         # popped-but-undispatched segments — the drain predicate — and
         # would read phantom overlap if exported as depth.
         self._dispatched = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock("batcher.inflight_gauge")
         # dispatch -> completion, FIFO; None is the shutdown sentinel.
         self._handles: queue.SimpleQueue = queue.SimpleQueue()
         self._dispatcher: Optional[threading.Thread] = None
@@ -251,9 +256,9 @@ class DynamicBatcher:
             raise RuntimeError(
                 "batcher is stopped; construct a new one instead of "
                 "restarting")
-        self._dispatcher = threading.Thread(
+        self._dispatcher = make_thread(
             target=self._dispatch_loop, name="serve-dispatch", daemon=True)
-        self._completer = threading.Thread(
+        self._completer = make_thread(
             target=self._completion_loop, name="serve-complete",
             daemon=True)
         self._dispatcher.start()

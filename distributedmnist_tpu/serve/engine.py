@@ -24,12 +24,15 @@ from __future__ import annotations
 import dataclasses
 import logging
 import statistics
-import threading
 import time
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from distributedmnist_tpu.analysis.locks import make_lock
+from distributedmnist_tpu.analysis.sanitize import (blocking,
+                                                    resource_acquire,
+                                                    resource_release)
 from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.utils import (CompileCounter,
                                         enable_compilation_cache, round_up)
@@ -160,7 +163,7 @@ class InferenceEngine:
         # were zero-copy on some backend.
         self._staging_pool: dict[int, list[np.ndarray]] = {
             b: [] for b in self.buckets}
-        self._staging_lock = threading.Lock()
+        self._staging_lock = make_lock("engine.staging")
         # Per-bucket measured dispatch cost (median end-to-end infer
         # seconds, timed by warmup AFTER each bucket compiles). This is
         # the Clockwork insight the batch former runs on: per-program
@@ -203,6 +206,11 @@ class InferenceEngine:
     # -- staging pool ------------------------------------------------------
 
     def _staging_take(self, bucket: int) -> np.ndarray:
+        # Balance-checked (ISSUE 8): every checkout here is matched by
+        # a recycle — fetch()'s finally on the normal path, dispatch()'s
+        # own error path otherwise — and the sanitizer asserts the net
+        # is zero at drain (the PR 5 leak class).
+        resource_acquire("engine.staging")
         with self._staging_lock:
             pool = self._staging_pool[bucket]
             if pool:
@@ -238,14 +246,28 @@ class InferenceEngine:
         failpoint("engine.dispatch", version=self.version, rows=n,
                   bucket=b)
         staging = self._staging_take(b)
-        off = 0
-        for p in parts:
-            staging[off:off + p.shape[0]] = p
-            off += p.shape[0]
-        if n < b:
-            staging[n:] = 0
-        x_dev = jax.device_put(staging, self._x_sharding)
-        logits = self._forward(self.params, x_dev)
+        # The checkout is exception-safe: a real backend error in
+        # device_put/dispatch (not the pre-take failpoint) must recycle
+        # the buffer HERE — otherwise the batcher's keep-serving
+        # failure path would bleed one pooled buffer per failed
+        # dispatch, the dispatch-side twin of the PR 5 fetch leak (the
+        # sanitizer's engine.staging balance pins this).
+        dispatched = False
+        try:
+            off = 0
+            for p in parts:
+                staging[off:off + p.shape[0]] = p
+                off += p.shape[0]
+            if n < b:
+                staging[n:] = 0
+            x_dev = jax.device_put(staging, self._x_sharding)
+            logits = self._forward(self.params, x_dev)
+            dispatched = True
+        finally:
+            if not dispatched:
+                with self._staging_lock:
+                    self._staging_pool[b].append(staging)
+                resource_release("engine.staging")
         return InferenceHandle(logits=logits, n=n, bucket=b,
                                staging=staging, version=self.version,
                                infer_dtype=self.infer_dtype)
@@ -269,11 +291,17 @@ class InferenceEngine:
             # schedule that forces a breaker trip keys on it.
             failpoint("engine.fetch", version=handle.version,
                       rows=handle.n)
+            # Sanitizer seam (ISSUE 8): this value fetch blocks until
+            # the device finishes the batch — flagged if any hot-path
+            # lock is held on this thread (device compute must never
+            # run under the registry/fleet/batcher locks).
+            blocking("engine.fetch device->host sync")
             return np.asarray(handle.logits)[:handle.n]
         finally:
             with self._staging_lock:
                 self._staging_pool[handle.bucket].append(handle.staging)
             handle.staging = None
+            resource_release("engine.staging")
 
     def infer(self, x) -> np.ndarray:
         """Logits (n, 10) for n uint8 images; pad-and-slice through the

@@ -56,9 +56,10 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import threading
 import time
 from typing import Optional
+
+from distributedmnist_tpu.analysis.locks import make_lock
 
 
 # Every failpoint woven through the serving stack, by name. parse_spec
@@ -207,7 +208,7 @@ class FaultInjector:
             raise ValueError("FaultInjector needs at least one rule")
         self.rules = list(rules)
         self.seed = seed
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.injector")
         self._rngs = [random.Random(f"{seed}:{i}")
                       for i in range(len(rules))]
         self._evals = [0] * len(rules)

@@ -67,12 +67,12 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import threading
 import time
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from distributedmnist_tpu.analysis.locks import make_condition, make_thread
 from distributedmnist_tpu.serve.batcher import resolve_max_inflight
 from distributedmnist_tpu.serve.engine import InferenceEngine
 from distributedmnist_tpu.serve.faults import failpoint
@@ -182,7 +182,7 @@ class ReplicaSet:
         self.hedge = hedge
         self.hedge_factor = (self.HEDGE_FACTOR if hedge_factor is None
                              else hedge_factor)
-        self._cond = threading.Condition()
+        self._cond = make_condition("fleet.pick")
         self._pick_seq = 0
         self._failovers_dispatch = 0
         self._failovers_fetch = 0
@@ -421,6 +421,41 @@ class ReplicaSet:
                   rows=n)
         return rep.router.fetch(fh_or_rh)
 
+    def _drain_abandoned(self, rep: _Replica, inner) -> None:
+        """A replica-targeted fetch died and its handle will never be
+        fetched again by the pipeline (failover moved the batch to a
+        sibling, or both hedge arms failed). If the death happened
+        BEFORE the engine's own fetch ran — the replica.fetch
+        failpoint, the chaos kill — the handle still pins a checked-out
+        staging buffer. Fetch-and-discard it on a detached daemon
+        thread, exactly the hedge-loser pattern: engine.fetch recycles
+        in its finally whether it succeeds, raises, or was already
+        fetched, and a wedged victim must not stall the rescue.
+        Without this, every killed fetch leaked one pooled buffer —
+        the PR 5 class on the fleet path, pinned by the sanitizer's
+        engine.staging balance.
+
+        Handles whose ENGINE fetch already ran (a real fetch error:
+        the engine recycled staging in its finally, and Router.fetch's
+        except branch already drained the shadow duplicate) are
+        SKIPPED, not re-fetched: a second Router.fetch would
+        double-enqueue the same shadow comparison and drift the
+        router's _shadow_pending claim count negative. An engine-
+        fetched InferenceHandle has staging None — the one-shot
+        marker; doubles without the attribute always drain."""
+        h = getattr(inner, "handle", inner)
+        if getattr(h, "staging", "never-fetched") is None:
+            return
+
+        def drain():
+            try:
+                rep.router.fetch(inner)
+            except Exception:
+                pass
+
+        make_thread(target=drain, name="serve-drain-abandoned",
+                    daemon=True).start()
+
     def fetch(self, fh: FleetHandle) -> np.ndarray:
         rep = self._by_id[fh.replica]
         if self.hedge:
@@ -456,6 +491,7 @@ class ReplicaSet:
         beats strict admission. A second failure propagates — the
         batcher's bisection/breaker path takes over, exactly as if the
         fleet were a single engine that failed."""
+        self._drain_abandoned(failed, fh.inner)
         sib = self._pick(fh.cost_s, exclude=frozenset((failed.rid,)),
                          block=False, overflow=True)
         if sib is None:
@@ -484,6 +520,7 @@ class ReplicaSet:
         except Exception as e2:
             self._release(sib, fh.cost_s)
             self._record(sib, ok=False)
+            self._drain_abandoned(sib, rescued.inner)
             log.warning("fleet: rescue fetch on %s failed too (%s)",
                         sib.rid, e2)
             raise cause
@@ -525,7 +562,7 @@ class ReplicaSet:
         lands in its runner, nothing leaks. Hedges are rare by
         construction (past the p95 threshold AND a free healthy
         sibling), so the two short-lived threads per hedge are noise."""
-        cv = threading.Condition()
+        cv = make_condition("fleet.hedge")
         results: dict = {}            # tag -> (ok, value) in arrival order
 
         def finish(tag, ok, value):
@@ -539,6 +576,7 @@ class ReplicaSet:
             except Exception as e:
                 self._release(rep, fh.cost_s)
                 self._record(rep, ok=False)
+                self._drain_abandoned(rep, fh.inner)
                 finish("primary", False, e)
                 return
             self._release(rep, fh.cost_s)
@@ -560,6 +598,7 @@ class ReplicaSet:
             except Exception as e:
                 self._release(sib, fh.cost_s)
                 self._record(sib, ok=False)
+                self._drain_abandoned(sib, dup.inner)
                 finish("hedge", False, e)
                 return
             self._release(sib, fh.cost_s)
@@ -574,8 +613,8 @@ class ReplicaSet:
         with self._cond:
             self._hedges += 1
         for target in (run_primary, run_hedge):
-            threading.Thread(target=target, name="serve-hedge",
-                             daemon=True).start()
+            make_thread(target=target, name="serve-hedge",
+                        daemon=True).start()
         with cv:
             while True:
                 for tag, (ok, value) in results.items():

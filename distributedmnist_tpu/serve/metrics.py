@@ -13,10 +13,10 @@ coalescing costs each request.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 
+from distributedmnist_tpu.analysis.locks import make_lock
 from distributedmnist_tpu.utils import MetricsLogger, percentiles
 
 
@@ -26,7 +26,7 @@ class ServeMetrics:
     bench resets between sweep points)."""
 
     def __init__(self, max_latency_samples: int = 100_000):
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.metrics")
         self._max_samples = max_latency_samples
         self.reset()
 
@@ -258,6 +258,7 @@ class ServeMetrics:
             self._rollbacks += 1
             self._last_rollback = {"from": from_version,
                                    "to": to_version,
+                                   # lint: allow[DML004] wall-clock event stamp for operators
                                    "at": round(time.time(), 3)}
 
     # -- fleet hooks (ISSUE 6) ---------------------------------------------
@@ -271,6 +272,7 @@ class ServeMetrics:
             self._failovers[kind] = self._failovers.get(kind, 0) + 1
             self._last_failover = {"kind": kind, "from": from_replica,
                                    "to": to_replica,
+                                   # lint: allow[DML004] wall-clock event stamp for operators
                                    "at": round(time.time(), 3)}
 
     def record_hedge(self, win: bool) -> None:

@@ -33,13 +33,13 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import threading
 import time
 from collections import deque
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from distributedmnist_tpu.analysis.locks import make_lock, make_rlock
 from distributedmnist_tpu.serve.engine import InferenceEngine, make_buckets
 from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.serve.router import Router
@@ -93,6 +93,7 @@ class VariantInfo:
 
     def record_error(self, error: str) -> None:
         self.last_error = error
+        # lint: allow[DML004] wall-clock incident stamp for operators, never elapsed math
         self.last_error_at = time.time()
 
     def describe(self) -> dict:
@@ -127,7 +128,12 @@ class ModelVersion:
     step: Optional[int] = None     # checkpoint step, when from disk
     warmup_compile_events: int = 0
     warmup_s: float = 0.0
-    loaded_at: float = 0.0         # time.time()
+    loaded_at: float = 0.0         # wall clock, display only
+    # Monotonic load sequence stamp: "newest healthy resident" ordering
+    # (rollback's fallback pick) must survive a wall-clock step — a
+    # backwards NTP jump re-ordering loaded_at could roll back to the
+    # WRONG version (ISSUE 8 lint DML004 finding, fixed).
+    loaded_mono: float = 0.0
     # The last failure this version suffered (restore/warmup exception
     # string, or the circuit-breaker trip reason that demoted it) plus
     # its wall-clock timestamp — surfaced in GET /models so an operator
@@ -144,6 +150,7 @@ class ModelVersion:
 
     def record_error(self, error: str) -> None:
         self.last_error = error
+        # lint: allow[DML004] wall-clock incident stamp for operators, never elapsed math
         self.last_error_at = time.time()
 
     def describe(self) -> dict:
@@ -321,8 +328,14 @@ class ModelRegistry:
         # surface byte-for-byte.
         self.n_replicas = getattr(router, "n_replicas", 1)
         self._versions: dict[str, ModelVersion] = {}   # insertion-ordered
-        self._admin = threading.RLock()
-        self._state = threading.Lock()
+        # blocking_ok: the admin lock serializes multi-second restores
+        # and warmups BY DESIGN (they run on admin/SIGHUP threads, never
+        # the dispatch path) — the sanitizer's blocking-under-lock check
+        # must not flag what the two-lock split exists to permit. _state
+        # stays hot-path strict: holding it across anything slow is
+        # exactly the PR 3 bug the split fixed.
+        self._admin = make_rlock("registry.admin", blocking_ok=True)
+        self._state = make_lock("registry.state")
         self._compiles = CompileCounter.instance()
         self._auto_id = 0
         # Lifecycle events an operator must be able to reconstruct
@@ -365,7 +378,10 @@ class ModelRegistry:
                         "serve_max_versions")
                 mv = ModelVersion(version=version, engine=None,
                                   state="warming", source=source,
-                                  step=step, loaded_at=time.time())
+                                  step=step,
+                                  # lint: allow[DML004] wall display stamp; ordering uses loaded_mono
+                                  loaded_at=time.time(),
+                                  loaded_mono=time.monotonic())
                 self._versions[version] = mv
             # Warmup runs OUTSIDE the state lock (it is seconds of XLA
             # compile): /healthz and GET /models stay answerable — they
@@ -485,7 +501,10 @@ class ModelRegistry:
                 mv = ModelVersion(version=version, engine=None,
                                   state="failed",
                                   source=f"checkpoint {directory}",
-                                  step=step, loaded_at=time.time())
+                                  step=step,
+                                  # lint: allow[DML004] wall display stamp; ordering uses loaded_mono
+                                  loaded_at=time.time(),
+                                  loaded_mono=time.monotonic())
                 mv.record_error(f"restore: {type(e).__name__}: {e}")
                 with self._state:
                     self._versions.setdefault(version, mv)
@@ -607,6 +626,7 @@ class ModelRegistry:
                                 "infer_dtype": infer_dtype,
                                 "to": "float32",
                                 "reason": existing.last_error,
+                                # lint: allow[DML004] wall-clock event stamp for operators
                                 "at": round(time.time(), 3)})
                         log.warning(
                             "registry: live variant %s of %s demoted "
@@ -616,6 +636,7 @@ class ModelRegistry:
                 return existing
             with self._state:
                 vi = VariantInfo(infer_dtype=infer_dtype,
+                                 # lint: allow[DML004] wall display stamp
                                  loaded_at=time.time())
                 mv.variants[infer_dtype] = vi
             # Warmup + gate run OUTSIDE the state lock, same as add():
@@ -801,6 +822,7 @@ class ModelRegistry:
                 mv for name, mv in self._versions.items()
                 if name != from_version and mv.state == "ready"
                 and mv.engines and mv.last_error is None]
+            # lint: allow[DML004] wall-clock event stamps; the fallback pick below orders by loaded_mono
             now = time.time()
             old = self._versions.get(from_version)
             if not candidates:
@@ -812,7 +834,9 @@ class ModelRegistry:
                     "fallback (%s); keeping the tripped version live",
                     from_version, reason)
                 return None
-            target = max(candidates, key=lambda mv: mv.loaded_at)
+            # Monotonic ordering: a wall-clock step between two loads
+            # must not make an older version read as "newest healthy".
+            target = max(candidates, key=lambda mv: mv.loaded_mono)
             # promote()'s core, inlined: _state is a plain Lock (not
             # re-entrant) and the demotion must also stamp last_error
             # atomically with the swap.

@@ -47,10 +47,11 @@ the single owner of dispatch mechanics, the registry of version state:
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from collections import deque
 from typing import Optional
+
+from distributedmnist_tpu.analysis.locks import make_lock, make_thread
 
 log = logging.getLogger("distributedmnist_tpu")
 
@@ -92,7 +93,7 @@ class CircuitBreaker:
         self.min_requests = min_requests
         self.failure_ratio = failure_ratio
         self.cooldown_s = cooldown_s
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.breaker")
         # version -> deque[(t, ok, n)] — n-weighted so one failed batch
         # of k requests carries its real volume
         self._windows: dict[str, deque] = {}
@@ -185,7 +186,7 @@ class HealthTracker:
                 f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
         self.window_s = window_s
         self.ewma_alpha = ewma_alpha
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.health")
         self._windows: dict[str, deque] = {}   # key -> (t, ok, n)
         self._ewma_s: dict[str, float] = {}
 
@@ -286,8 +287,8 @@ class ResiliencePolicy:
             self.metrics.record_breaker_trip(version)
         if self.registry is None:
             return
-        threading.Thread(target=self._rollback, args=(version,),
-                         name="serve-rollback", daemon=True).start()
+        make_thread(target=self._rollback, args=(version,),
+                    name="serve-rollback", daemon=True).start()
 
     def _rollback(self, version: str) -> None:
         try:

@@ -45,6 +45,7 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from distributedmnist_tpu.analysis.locks import make_lock, make_thread
 from distributedmnist_tpu.serve.engine import InferenceEngine
 from distributedmnist_tpu.serve.faults import failpoint
 
@@ -122,7 +123,7 @@ class Router:
         # sampled batch counts as dropped) must be honored.
         self.shadow_cap = (self.SHADOW_CAP if shadow_cap is None
                            else shadow_cap)
-        self._lock = threading.Lock()
+        self._lock = make_lock("router.routes")
         self._live: Optional[_Target] = None
         self._canary: Optional[_Target] = None
         self._shadow: Optional[_Target] = None
@@ -140,7 +141,7 @@ class Router:
         # shadow fetches are fine.
         self._shadow_q: queue.SimpleQueue = queue.SimpleQueue()
         self._shadow_pending = 0
-        self._shadow_pending_lock = threading.Lock()
+        self._shadow_pending_lock = make_lock("router.shadow_pending")
         self._shadow_thread: Optional[threading.Thread] = None
 
     # Engine-shape parity: borrow the engine's own implementations —
@@ -341,7 +342,7 @@ class Router:
     def _enqueue_shadow(self, rh: RoutedHandle, out) -> None:
         with self._shadow_pending_lock:
             if self._shadow_thread is None:
-                self._shadow_thread = threading.Thread(
+                self._shadow_thread = make_thread(
                     target=self._shadow_loop, name="serve-shadow",
                     daemon=True)
                 self._shadow_thread.start()

@@ -38,10 +38,11 @@ batcher stays the single owner of dispatch mechanics:
 from __future__ import annotations
 
 import math
-import threading
 import time
 from bisect import bisect_right
 from typing import Mapping, Optional, Sequence
+
+from distributedmnist_tpu.analysis.locks import make_lock
 
 
 def fit_dispatch_cost(costs: Mapping[int, float]) -> tuple[float, float]:
@@ -201,7 +202,7 @@ class AdaptiveController:
         self.increase_s = increase_frac * self.max_wait_s
         self.window = window
         self.rate_tau_s = rate_tau_s
-        self._lock = threading.Lock()
+        self._lock = make_lock("scheduler.aimd")
         self._wait_s = self.max_wait_s    # start at the configured point
         self._rate = 0.0                  # rows/sec EWMA
         self._t_last: Optional[float] = None

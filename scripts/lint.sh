@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# The project lint gate (ISSUE 8): AST rules codified from the serving
+# stack's recurring review findings (bare threading primitives,
+# unknown failpoint names, wall-clock timing, jit outside the engine,
+# recycle outside finally — `--list-rules` prints the table with the
+# historical bug each rule encodes).
+#
+# Exit-code contract: 0 clean, 1 findings (printed as file:line RULE
+# message), 2 internal lint error. scripts/tier1.sh runs this BEFORE
+# pytest, so a lint regression fails tier-1 without burning a test run;
+# run it alone while iterating:
+#
+#   bash scripts/lint.sh                  # the gate
+#   bash scripts/lint.sh --list-rules     # rule table
+#   bash scripts/lint.sh --show-allowed   # include pragma'd findings
+cd "$(dirname "$0")/.." || exit 1
+exec python -m distributedmnist_tpu.analysis "$@"

@@ -8,10 +8,15 @@
 # driver compares across rounds.
 #
 # Marker note: the `-m 'not slow'` selection below INCLUDES the chaos,
-# fleet and quant suites (tests/conftest.py registers the markers) —
-# they are cheap and deterministic by design, so the tier-1 gate covers
-# fault injection, the replica fleet, and the quantized inference fast
-# path on every run. `pytest -m quant` selects the fast-path suite
-# alone.
+# fleet, quant and analysis suites (tests/conftest.py registers the
+# markers) — they are cheap and deterministic by design, so the tier-1
+# gate covers fault injection, the replica fleet, the quantized
+# inference fast path, and the concurrency sanitizer/lint on every run.
+# `pytest -m quant` / `-m analysis` select those suites alone.
 cd "$(dirname "$0")/.." || exit 1
+# The project lint runs FIRST (ISSUE 8): a lint regression (bare
+# threading primitive, unknown failpoint name, wall-clock timing, ...)
+# fails the gate in ~a second instead of after a full pytest run.
+# scripts/lint.sh exit codes: 0 clean, 1 findings, 2 lint error.
+bash scripts/lint.sh || exit $?
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

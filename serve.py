@@ -119,6 +119,7 @@ import threading
 import time
 
 from distributedmnist_tpu import config as config_lib
+from distributedmnist_tpu.analysis.locks import make_lock, make_thread
 
 IMAGE_BYTES = 28 * 28
 
@@ -135,13 +136,18 @@ class ServerState:
     shutting-down server to "running"."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.state")
         self.phase = "warming"
-        # Process start, wall clock: /healthz reports it (ISO 8601) plus
-        # a derived uptime so fleet-level probes and the bench ledger
-        # can tell a RESTARTED worker (uptime reset) from a RECOVERED
-        # one (uptime continuous across the unhealthy window).
+        # Process start, wall clock: /healthz reports it (ISO 8601) so
+        # fleet-level probes and the bench ledger can tell a RESTARTED
+        # worker (stamp reset) from a RECOVERED one.
+        # lint: allow[DML004] wall-clock birth stamp for the ISO healthz field only
         self.started_at = time.time()
+        # uptime_s derives from the monotonic clock (ISSUE 8 lint
+        # DML004 finding, fixed): wall-clock elapsed math would jump
+        # with every NTP step — an uptime that moves backwards reads
+        # as a restart that never happened.
+        self._started_mono = time.monotonic()
 
     def mark_running(self) -> None:
         """warming/failed -> running (no-op from draining)."""
@@ -195,7 +201,7 @@ class ServerState:
             "started_at": datetime.datetime.fromtimestamp(
                 self.started_at,
                 datetime.timezone.utc).isoformat(timespec="seconds"),
-            "uptime_s": round(time.time() - self.started_at, 3),
+            "uptime_s": round(time.monotonic() - self._started_mono, 3),
             "live_version": live,
             # which precision the live engines serve (ISSUE 7
             # satellite): float32 reference vs a gated bf16/int8
@@ -269,6 +275,28 @@ def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
             "rejected_at_submit": rejected, **metrics.snapshot()}
 
 
+def _sanitizer_block() -> dict:
+    """The concurrency sanitizer's findings for the summary lines, when
+    one is installed (DMNIST_SANITIZE=1 — ISSUE 8): a local run that
+    tripped a lock-order cycle or leaked a staging buffer must say so
+    in its exit record, not only inside pytest."""
+    from distributedmnist_tpu.analysis import sanitize
+
+    san = sanitize.active_sanitizer()
+    if san is None:
+        return {}
+    # Let the pipeline settle first (assert_clean's contract is "after
+    # drain"): a snapshot taken the instant the last future resolved
+    # could read a transient +1 as a leak.
+    san.wait_drained(timeout_s=2.0)
+    rep = san.report()
+    clean = not any(rep.values())
+    if not clean:
+        log.warning("concurrency sanitizer findings: %s",
+                    {k: v for k, v in rep.items() if v})
+    return {"sanitizer": {"clean": clean, **rep}}
+
+
 def _http_serve(batcher, metrics, registry, state, port: int,
                 metrics_every: float, request_timeout: float,
                 warm, retry_after_cap_s: float = 30.0,
@@ -293,7 +321,9 @@ def _http_serve(batcher, metrics, registry, state, port: int,
     # concurrent loads can't interleave their registry side effects
     # mid-request (the registry's own lock already protects state; this
     # one keeps *responses* coherent, e.g. load-then-promote scripts).
-    admin_lock = threading.Lock()
+    # blocking_ok: it deliberately holds across multi-second restores
+    # and warmups — admin threads only, never the dispatch path.
+    admin_lock = make_lock("serve.admin", blocking_ok=True)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -603,8 +633,7 @@ def _http_serve(batcher, metrics, registry, state, port: int,
             log.exception("initial model load/warm failed; serving "
                           "503s until an admin load succeeds")
 
-    threading.Thread(target=_warm, name="serve-warm",
-                     daemon=True).start()
+    make_thread(target=_warm, name="serve-warm", daemon=True).start()
 
     stop = threading.Event()
 
@@ -612,7 +641,7 @@ def _http_serve(batcher, metrics, registry, state, port: int,
         while not stop.wait(metrics_every):
             print(metrics.heartbeat_line(), flush=True)
 
-    beat = threading.Thread(target=_beat, daemon=True)
+    beat = make_thread(target=_beat, name="serve-heartbeat", daemon=True)
     beat.start()
 
     def _shutdown(signum, frame):
@@ -620,7 +649,8 @@ def _http_serve(batcher, metrics, registry, state, port: int,
         # here while in-flight work finishes; shutdown() must come from
         # another thread than serve_forever()
         state.begin_drain()
-        threading.Thread(target=srv.shutdown, daemon=True).start()
+        make_thread(target=srv.shutdown, name="serve-shutdown",
+                    daemon=True).start()
 
     def _reload(signum, frame):
         # SIGHUP = roll the model: params-only restore of the latest
@@ -654,8 +684,8 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                         "on %s; float32 stays live for it",
                         infer_dtype_choice, mv.version)
 
-        threading.Thread(target=run, name="serve-reload",
-                         daemon=True).start()
+        make_thread(target=run, name="serve-reload",
+                    daemon=True).start()
 
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
@@ -797,6 +827,13 @@ def main(argv=None) -> int:
                                       cfg.serve_infer_dtype))
     finally:
         batcher.stop()
+    # Sanitizer verdict AFTER stop() (DMNIST_SANITIZE=1 runs): the
+    # dispatch thread holds a legitimate pre-coalescing lookahead slot
+    # while the batcher is merely idle — "slots net zero" is only a
+    # valid invariant once the pipeline is actually stopped, so a
+    # snapshot taken mid-serve would flakily report that hold as a
+    # leak.
+    summary.update(_sanitizer_block())
     print(json.dumps(summary), flush=True)
     return 0
 

@@ -46,6 +46,22 @@ def pytest_configure(config):
         "deterministic, so quant tests run in tier-1 — `-m 'not slow'` "
         "keeps them, `-m quant` selects just this suite "
         "(scripts/tier1.sh notes the inclusion)")
+    config.addinivalue_line(
+        "markers",
+        "analysis: static-analysis / concurrency-sanitizer test "
+        "(distributedmnist_tpu/analysis: the lock-order sanitizer, "
+        "resource-balance accounting, and the AST project lint); pure "
+        "python, runs in tier-1 — `-m analysis` selects just this "
+        "suite")
+    # A DMNIST_SANITIZE=1 environment installs a process-global
+    # sanitizer at import time — under pytest that instance must yield
+    # to the per-test installs (the serve autouse fixture and the
+    # analysis tests each install a FRESH one for isolation, and
+    # install_sanitizer refuses to stack). Without this, exporting the
+    # README-advertised env var would error every serve test at setup.
+    from distributedmnist_tpu.analysis import sanitize
+    if sanitize.active_sanitizer() is not None:
+        sanitize.uninstall_sanitizer()
 
 
 def committed_steps(ckpt_dir: str) -> list:
@@ -88,6 +104,39 @@ def wait_for_committed_checkpoint(ckpt_dir: str, procs,
                 + p.communicate()[0][-3000:])
         time.sleep(0.2)
     pytest.fail("no checkpoint committed within the deadline")
+
+
+@pytest.fixture(autouse=True)
+def serve_sanitizer(request):
+    """Run EVERY serve test under the installed concurrency sanitizer
+    (ISSUE 8) and fail it on any finding at teardown: lock-order
+    cycles (potential deadlock), blocking calls under a hot-path lock,
+    and nonzero resource balances once drained (leaked staging-pool
+    buffers / in-flight window slots — the PR 3/PR 5 review-round bug
+    classes, asserted mechanically instead of re-found by hand).
+    Serve code constructs its primitives via analysis.locks.make_*, so
+    objects built inside the test are instrumented; with no sanitizer
+    (every other test, and production) those factories return bare
+    threading primitives."""
+    if "test_serve" not in os.path.basename(str(request.node.fspath)):
+        yield
+        return
+    from distributedmnist_tpu.analysis import sanitize
+
+    san = sanitize.install_sanitizer()
+    try:
+        yield
+        # Grace window first: an orderly stop() may still be fanning
+        # out its last batch on daemon threads — balances settle to
+        # zero as those complete (same rationale as the thread-hygiene
+        # fixture below).
+        san.wait_drained(timeout_s=5.0)
+        try:
+            san.assert_clean()
+        except AssertionError as e:
+            pytest.fail(str(e))
+    finally:
+        sanitize.uninstall_sanitizer()
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,240 @@
+"""distributedmnist_tpu/analysis/lint.py: every rule demonstrated by a
+planted violation asserting the exact rule ID, the pragma allowlist
+contract (reason REQUIRED), scope boundaries, and the repo-at-HEAD
+gate (`python -m distributedmnist_tpu.analysis` exits 0 — the
+acceptance criterion scripts/tier1.sh enforces before pytest)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from distributedmnist_tpu.analysis import lint
+
+pytestmark = pytest.mark.analysis
+
+SERVE_REL = "distributedmnist_tpu/serve/somemodule.py"
+
+
+def _rules(text, rel=SERVE_REL):
+    return [f.rule for f in lint.lint_source(text, rel)]
+
+
+def _active_rules(text, rel=SERVE_REL):
+    findings = lint.lint_source(text, rel)
+    active, _ = lint.apply_allowlist(findings, text.splitlines())
+    return [f.rule for f in active]
+
+
+# -- DML001: bare threading primitives ------------------------------------
+
+
+def test_dml001_bare_lock_flagged_in_serve():
+    src = "import threading\nlock = threading.Lock()\n"
+    assert _rules(src) == ["DML001"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert f.line == 2 and "make_lock" in f.message
+
+
+@pytest.mark.parametrize("prim", ["RLock", "Condition", "Semaphore",
+                                  "BoundedSemaphore"])
+def test_dml001_all_primitives(prim):
+    assert _rules(f"import threading\nx = threading.{prim}()\n") == [
+        "DML001"]
+
+
+def test_dml001_scope_excludes_tests_and_analysis():
+    src = "import threading\nlock = threading.Lock()\n"
+    assert _rules(src, "tests/test_serve_batcher.py") == []
+    assert _rules(src, "distributedmnist_tpu/analysis/locks.py") == []
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+    # serve.py at repo root IS in scope (it builds the serving process)
+    assert _rules(src, "serve.py") == ["DML001"]
+
+
+def test_dml001_factory_calls_are_clean():
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "lock = make_lock('engine.staging')\n")
+    assert _rules(src) == []
+
+
+# -- DML002: bare threads --------------------------------------------------
+
+
+def test_dml002_bare_thread_flagged():
+    src = ("import threading\n"
+           "t = threading.Thread(target=print, daemon=True)\n")
+    assert _rules(src) == ["DML002"]
+    # bench.py is in thread scope too (its client threads)
+    assert _rules(src, "bench.py") == ["DML002"]
+    assert _rules(src, "tests/test_x.py") == []
+
+
+def test_dml002_event_is_not_a_thread():
+    assert _rules("import threading\ne = threading.Event()\n") == []
+
+
+# -- DML003: failpoint registry -------------------------------------------
+
+
+def test_dml003_unknown_failpoint_call():
+    src = "failpoint('engine.fetsh', rows=1)\n"
+    assert _rules(src) == ["DML003"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert "engine.fetsh" in f.message
+
+
+def test_dml003_known_failpoint_clean():
+    assert _rules("failpoint('engine.fetch', rows=1)\n") == []
+
+
+def test_dml003_spec_string_in_parse_spec():
+    assert _rules("parse_spec('engine.fetch:p=1;batch.dspatch:p=1')\n"
+                  ) == ["DML003"]
+    assert _rules("parse_spec('engine.fetch:p=1;batch.dispatch:p=1')\n"
+                  ) == []
+
+
+def test_dml003_spec_shaped_literal_anywhere():
+    """The bench's programmatically-concatenated schedules: every
+    spec-shaped string constant is checked, in ANY repo file —
+    including f-string fragments."""
+    src = 'spec = "replica.ftch:p=1,replica=r1"\n'
+    assert _rules(src, "bench.py") == ["DML003"]
+    assert _rules(src, "tests/test_x.py") == ["DML003"]
+    # f-string fragments: the constant piece before the placeholder
+    src2 = 'spec = f"engine.fetsh:p=1,version={v}"\n'
+    assert _rules(src2, "bench.py") == ["DML003"]
+    ok = 'spec = f"engine.fetch:p=1,version={v}"\n'
+    assert _rules(ok, "bench.py") == []
+
+
+def test_dml003_prose_and_plain_strings_not_flagged():
+    # docstrings and non-spec-shaped strings are prose, not schedules
+    src = ('"""mentions engine.whatever in prose."""\n'
+           'x = "registry.state"\n'
+           'y = "no colons here"\n')
+    assert _rules(src, "bench.py") == []
+
+
+# -- DML004: wall-clock timing --------------------------------------------
+
+
+def test_dml004_time_time_flagged_in_scope():
+    src = "import time\nt0 = time.time()\n"
+    assert _rules(src) == ["DML004"]
+    assert _rules(src, "serve.py") == ["DML004"]
+    assert _rules(src, "bench.py") == ["DML004"]
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+
+
+def test_dml004_monotonic_clean():
+    assert _rules("import time\nt0 = time.monotonic()\n"
+                  "t1 = time.perf_counter()\n") == []
+
+
+# -- DML005: jit outside the engine ---------------------------------------
+
+
+def test_dml005_jit_outside_engine_flagged():
+    src = "import jax\nf = jax.jit(lambda x: x)\n"
+    assert _rules(src, "distributedmnist_tpu/serve/router.py") == [
+        "DML005"]
+    # the engine/warmup construction paths are the sanctioned homes
+    assert _rules(src, "distributedmnist_tpu/serve/engine.py") == []
+    assert _rules(src, "distributedmnist_tpu/serve/quantize.py") == []
+    # outside serve/ the rule does not apply (training jits freely)
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+
+
+# -- DML006: recycle outside finally --------------------------------------
+
+
+def test_dml006_recycle_outside_finally_flagged():
+    src = ("def fetch(self, handle):\n"
+           "    out = read(handle)\n"
+           "    self._staging_pool[handle.bucket].append(handle.staging)\n"
+           "    return out\n")
+    assert _rules(src) == ["DML006"]
+
+
+def test_dml006_recycle_in_finally_clean():
+    src = ("def fetch(self, handle):\n"
+           "    try:\n"
+           "        return read(handle)\n"
+           "    finally:\n"
+           "        self._staging_pool[handle.bucket].append(\n"
+           "            handle.staging)\n")
+    assert _rules(src) == []
+
+
+# -- allowlist pragma ------------------------------------------------------
+
+
+def test_pragma_with_reason_suppresses():
+    src = ("import time\n"
+           "t = time.time()  # lint: allow[DML004] wall stamp for humans\n")
+    assert _active_rules(src) == []
+    findings = lint.lint_source(src, SERVE_REL)
+    _, allowed = lint.apply_allowlist(findings, src.splitlines())
+    assert allowed and allowed[0].allow_reason == "wall stamp for humans"
+
+
+def test_pragma_on_preceding_line_suppresses():
+    src = ("import time\n"
+           "# lint: allow[DML004] wall stamp\n"
+           "t = time.time()\n")
+    assert _active_rules(src) == []
+
+
+def test_pragma_without_reason_does_not_suppress():
+    src = "import time\nt = time.time()  # lint: allow[DML004]\n"
+    assert _active_rules(src) == ["DML004"]
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = ("import time\n"
+           "t = time.time()  # lint: allow[DML001] wrong rule id\n")
+    assert _active_rules(src) == ["DML004"]
+
+
+# -- the repo gate ---------------------------------------------------------
+
+
+def test_repo_at_head_is_clean():
+    """The acceptance criterion: zero active findings over the repo
+    (pre-existing violations are fixed or reason-allowlisted)."""
+    active, allowed = lint.lint_paths(lint.repo_root())
+    assert not active, "\n".join(f.format() for f in active)
+    # ... and every allowlisted finding carries a reason
+    assert all(f.allow_reason for f in allowed)
+
+
+def test_cli_contract():
+    """`python -m distributedmnist_tpu.analysis` exits 0 at HEAD and
+    prints the summary; --list-rules names every rule."""
+    r = subprocess.run([sys.executable, "-m",
+                        "distributedmnist_tpu.analysis"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s)" in r.stderr
+    r2 = subprocess.run([sys.executable, "-m",
+                         "distributedmnist_tpu.analysis", "--list-rules"],
+                        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 0
+    for rule in lint.RULES:
+        assert rule in r2.stdout
+
+
+def test_cli_exits_nonzero_on_findings(tmp_path):
+    """The exit-code contract scripts/lint.sh relies on: findings -> 1."""
+    pkg = tmp_path / "distributedmnist_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text("import threading\n"
+                                "lock = threading.Lock()\n")
+    r = subprocess.run([sys.executable, "-m",
+                        "distributedmnist_tpu.analysis", "--root",
+                        str(tmp_path)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "DML001" in r.stdout

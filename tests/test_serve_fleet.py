@@ -230,6 +230,83 @@ def test_injected_replica_kill_is_rescued_end_to_end(rng):
     assert snap["replicas"][1]["failures"] == 3
 
 
+@pytest.mark.chaos
+def test_killed_fetch_drains_abandoned_staging(rng):
+    """A replica.fetch kill fires BEFORE the engine's own fetch runs,
+    so the victim's handle still pins its staging checkout when
+    failover moves the batch to the sibling. The fleet must drain the
+    abandoned handle (fetch-and-discard on a daemon thread, the
+    hedge-loser pattern) so the balance returns to zero — otherwise
+    every killed fetch leaks one pooled buffer (the PR 5 class on the
+    fleet path; the conftest sanitizer fixture would fail this test's
+    teardown without the drain)."""
+    from distributedmnist_tpu.analysis import sanitize
+
+    class AccountingRouter(StubRouter):
+        """StubRouter plus engine-style staging accounting: checkout at
+        dispatch, recycle-in-finally at fetch, one-shot handles."""
+
+        def dispatch(self, x):
+            rh = super().dispatch(x)
+            sanitize.resource_acquire("engine.staging")
+            rh.staged = True
+            return rh
+
+        def fetch(self, rh):
+            if not getattr(rh, "staged", False):
+                raise RuntimeError("handle already fetched")
+            try:
+                return super().fetch(rh)
+            finally:
+                rh.staged = False
+                sanitize.resource_release("engine.staging")
+
+    san = sanitize.active_sanitizer()
+    assert san is not None        # the conftest autouse fixture's
+    routers = [AccountingRouter(f"r{i}") for i in range(2)]
+    fleet = ReplicaSet(routers, per_replica_inflight=2)
+    faults.install(faults.FaultInjector.from_spec(
+        "replica.fetch:p=1,replica=r1,count=2", seed=5))
+    try:
+        for _ in range(6):
+            assert fleet.infer(_req(rng)).shape == (4, 10)
+    finally:
+        faults.uninstall()
+    assert fleet.snapshot()["failovers"]["fetch"] == 2
+    # the drains run on daemon threads — give them a moment to land
+    assert san.wait_drained(), (
+        "killed fetches leaked their staging checkouts: "
+        f"{san.balances()}")
+    assert not san.resource_errors()
+
+
+def test_drain_abandoned_skips_engine_fetched_handles():
+    """A handle whose ENGINE fetch already ran (real fetch error: the
+    engine recycled staging in its finally and Router.fetch's except
+    already drained the shadow duplicate) must NOT be re-fetched by the
+    abandonment drain — a second Router.fetch would double-enqueue the
+    same shadow comparison and drift the router's _shadow_pending claim
+    count negative. An engine-fetched InferenceHandle has staging None
+    (the one-shot marker); a never-fetched one still drains."""
+    fleet, routers = _fleet(n=2)
+    drained = []
+    routers[0].fetch = drained.append
+
+    fetched = SimpleNamespace(handle=SimpleNamespace(staging=None))
+    fleet._drain_abandoned(fleet.replicas[0], fetched)
+    unfetched = SimpleNamespace(
+        handle=SimpleNamespace(staging=np.zeros(1)))
+    fleet._drain_abandoned(fleet.replicas[0], unfetched)
+
+    deadline = time.monotonic() + 5.0
+    while not drained and time.monotonic() < deadline:
+        time.sleep(0.02)
+    time.sleep(0.1)               # give a wrong extra drain time to land
+    assert drained == [unfetched], (
+        "drain must skip engine-fetched handles and fetch abandoned "
+        f"ones exactly once; got {drained}")
+
+
 def test_breaker_trip_excludes_replica_then_limp_mode(rng):
     fleet, routers = _fleet(n=2)
     # trip r1: feed it failures directly through the recording path

@@ -55,6 +55,7 @@ def test_fault_spec_rejects_malformed():
                 "engine.fetch:latency_ms=-1", "engine.fetch:p",
                 # a typo'd failpoint must fail at install, never become
                 # a schedule that silently injects nothing
+                # lint: allow[DML003] deliberately-bad spec: this test asserts parse_spec rejects it
                 "engine.fetsh:p=1", "nope:p=1"):
         with pytest.raises(ValueError):
             parse_spec(bad)
