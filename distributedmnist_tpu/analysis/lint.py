@@ -62,6 +62,14 @@ RULES = {
         "the PR 5 leak: engine.fetch recycled its pooled buffer only on "
         "success, so a fetch-failure storm bled one buffer per failed "
         "batch — every _staging_pool append must sit in try/finally"),
+    "DML007": (
+        "trace span begun without a try/finally end",
+        "an exception mid-stage must not leave an unclosed span skewing "
+        "attribution (ISSUE 9): every begin_span() in serve/+serve.py "
+        "must be the statement immediately before a try whose finally "
+        "calls end_span(). Spans that end on another thread are "
+        "synthesized closed via add_span from monotonic stamps instead "
+        "— begin_span is strictly same-thread"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -113,6 +121,13 @@ def _jit_scope(rel: str) -> bool:
 
 def _failpoint_scope(rel: str) -> bool:
     return True
+
+
+def _span_scope(rel: str) -> bool:
+    # trace.py is the facility (its module-level begin_span delegates
+    # to the active tracer) — the rule polices the CALL sites.
+    return (_primitive_scope(rel)
+            and os.path.basename(rel) != "trace.py")
 
 
 # -- helpers ---------------------------------------------------------------
@@ -285,6 +300,48 @@ def lint_source(text: str, rel: str) -> list:
                         f"spec-shaped literal names unknown failpoint "
                         f"{name!r} — a schedule built from it would "
                         "inject nothing"))
+
+    # DML007: a begin_span() whose statement is not immediately
+    # followed by a try with an end_span() in a finally. Statement
+    # lists are scanned structurally (function bodies, if/for/with
+    # bodies, except handlers), so a begin at any nesting depth is
+    # checked against ITS OWN statement list. Only simple statements
+    # can carry the call (they hold no nested statement lists), so
+    # nothing is double-reported.
+    if _span_scope(rel):
+        simple = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr,
+                  ast.Return, ast.Raise)
+        for node in ast.walk(tree):
+            bodies = [getattr(node, f) for f in ("body", "orelse",
+                                                 "finalbody")
+                      if isinstance(getattr(node, f, None), list)]
+            for stmts in bodies:
+                for i, stmt in enumerate(stmts):
+                    if not isinstance(stmt, simple):
+                        continue
+                    begins = [sub for sub in ast.walk(stmt)
+                              if isinstance(sub, ast.Call)
+                              and _call_name(sub.func) == "begin_span"]
+                    if not begins:
+                        continue
+                    nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                    protected = (
+                        isinstance(stmt, ast.Assign)
+                        and isinstance(nxt, ast.Try) and any(
+                            isinstance(sub, ast.Call)
+                            and _call_name(sub.func) == "end_span"
+                            and id(sub) in in_finally
+                            for sub in ast.walk(nxt)))
+                    if not protected:
+                        findings.append(Finding(
+                            rel, begins[0].lineno, "DML007",
+                            "begin_span() without an immediate "
+                            "try/finally end_span — an exception "
+                            "mid-stage would leave the span open and "
+                            "skew every attribution derived from it "
+                            "(spans ending on another thread use "
+                            "add_span with measured endpoints "
+                            "instead)"))
     return findings
 
 

@@ -163,6 +163,18 @@ class Config:
     serve_replica_inflight: Optional[int] = None
     serve_hedge: bool = False
     serve_retry_after_cap_s: float = 30.0
+    # Request tracing (ISSUE 9, serve/trace.py): serve_trace installs
+    # the per-request span tracer — GET /trace exports Chrome
+    # trace-event JSON, /predict responses carry X-Trace-Id (and an
+    # opt-in Server-Timing breakdown), and /metrics gains per-stage
+    # duration histograms. serve_trace_sample head-samples which OK
+    # traces are retained (errored and over-SLO requests are ALWAYS
+    # kept — tail attribution is the point); serve_trace_capacity
+    # bounds the retention ring. Default off: every woven span hook is
+    # then one module-global None check.
+    serve_trace: bool = False
+    serve_trace_sample: float = 1.0
+    serve_trace_capacity: int = 256
     # Inference fast path (ISSUE 7, serve/quantize.py): the serving
     # precision. "float32" runs the training-identical reference
     # forward; "bfloat16"/"int8" run the inference-specialized low-
@@ -342,6 +354,20 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "
                         "RFC 9110) on shed responses")
+    p.add_argument("--serve-trace", dest="serve_trace",
+                   action="store_true", default=None,
+                   help="[serving] per-request span tracing: GET "
+                        "/trace exports Chrome trace-event JSON, "
+                        "/predict responses carry X-Trace-Id, /metrics "
+                        "gains per-stage duration histograms (errored "
+                        "and over-SLO traces always retained)")
+    p.add_argument("--serve-trace-sample", type=float, default=None,
+                   help="[serving] head-sampling fraction for OK "
+                        "traces in the retention ring (exemplars are "
+                        "never sampled out); default 1.0")
+    p.add_argument("--serve-trace-capacity", type=int, default=None,
+                   help="[serving] bounded retention ring size in "
+                        "traces (default 256)")
     p.add_argument("--no-flat-optimizer", dest="flat_optimizer",
                    action="store_false", default=None,
                    help="per-leaf optimizer update instead of the fused "

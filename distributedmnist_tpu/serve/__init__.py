@@ -28,6 +28,11 @@ north star's "serves heavy traffic from millions of users".
 - fleet.py    fault-tolerant replica set (ISSUE 6): health-tracked
               cost-aware dispatch over N per-replica routers, failover
               redispatch, hedged tails, drain/rejoin
+- trace.py    end-to-end request tracing (ISSUE 9): request-scoped
+              span trees woven through every layer above, head
+              sampling with error/over-SLO exemplars, Chrome
+              trace-event export, stage attribution, and the
+              per-stage histograms behind /metrics' Prometheus surface
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -92,6 +97,11 @@ _EXPORTS = {
     "FleetHandle": ("distributedmnist_tpu.serve.fleet", "FleetHandle"),
     "NoReplicaAvailable": ("distributedmnist_tpu.serve.fleet",
                            "NoReplicaAvailable"),
+    "Tracer": ("distributedmnist_tpu.serve.trace", "Tracer"),
+    "attribute_stages": ("distributedmnist_tpu.serve.trace",
+                         "attribute_stages"),
+    "prometheus_exposition": ("distributedmnist_tpu.serve.metrics",
+                              "prometheus_exposition"),
 }
 
 __all__ = list(_EXPORTS)

@@ -54,6 +54,16 @@ breaker (ResiliencePolicy.record_outcome), whose trip auto-rolls the
 live version back. The dispatch site is a named failpoint
 (`batch.dispatch`, ctx=request ids) so serve/faults.py can inject
 deterministic poison for tests and `bench.py serve --chaos`.
+
+Tracing (ISSUE 9, serve/trace.py): with a tracer installed, every
+request's path through this pipeline is recorded as a span tree —
+queue wait, the coalesce window, the batch former's plan, dispatch,
+the dispatched-but-unfetched window (the ISSUE 2 overlap, visible per
+batch), the blocking fetch — plus deadline sheds and bisection splits
+as structured child spans. Traces finish BEFORE their futures resolve,
+so a response-side lookup (serve.py's Server-Timing) always reads a
+complete tree. Uninstalled (the default), every hook is one
+module-global None check.
 """
 
 from __future__ import annotations
@@ -69,6 +79,7 @@ from typing import Optional
 
 from distributedmnist_tpu.analysis.locks import (make_condition, make_lock,
                                                  make_semaphore, make_thread)
+from distributedmnist_tpu.serve import trace
 from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.serve.resilience import DeadlineExceeded
 from distributedmnist_tpu.serve.scheduler import (AdaptiveController,
@@ -213,18 +224,33 @@ class DynamicBatcher:
                 f"({(now - deadline_s) * 1e3:.1f} ms ago)")
         req = _Request(x=x, n=n, t_enqueue=now, rid=next(self._rid),
                        deadline=deadline_s)
-        with self._cond:
-            if self._stop:
-                raise RuntimeError("batcher is stopped")
-            if self._rows + n > self.queue_depth:
-                if self.metrics is not None:
-                    self.metrics.record_reject(n)
-                raise Rejected(
-                    f"queue at {self._rows} pending rows; watermark "
-                    f"{self.queue_depth} would be exceeded by {n} more")
-            self._q.append(req)
-            self._rows += n
-            self._cond.notify_all()
+        tr = trace.active()
+        if tr is not None:
+            # Trace opened BEFORE the queue insert so the dispatch
+            # thread's pop-side spans always find it; the id rides the
+            # future so serve.py can stamp X-Trace-Id on the response.
+            req.future.trace_id = tr.start_request(
+                req.rid, rows=n, deadline_s=deadline_s,
+                t0=req.t_enqueue)
+        try:
+            with self._cond:
+                if self._stop:
+                    raise RuntimeError("batcher is stopped")
+                if self._rows + n > self.queue_depth:
+                    if self.metrics is not None:
+                        self.metrics.record_reject(n)
+                    raise Rejected(
+                        f"queue at {self._rows} pending rows; watermark "
+                        f"{self.queue_depth} would be exceeded by {n} "
+                        "more")
+                self._q.append(req)
+                self._rows += n
+                self._cond.notify_all()
+        except Exception:
+            # never admitted: nothing will ever finish this trace
+            if tr is not None:
+                tr.abort_request(req.rid)
+            raise
         if self.controller is not None:
             self.controller.on_arrival(n, now=req.t_enqueue)
         return req.future
@@ -284,8 +310,9 @@ class DynamicBatcher:
                 while self._q:
                     req = self._q.popleft()
                     self._rows -= req.n
-                    req.future.set_exception(
-                        RuntimeError("batcher stopped"))
+                    err = RuntimeError("batcher stopped")
+                    self._finish_trace(req, error=err)
+                    req.future.set_exception(err)
             self._cond.notify_all()
         timeout = 30 if drain else 1
         for t in (self._dispatcher, self._completer):
@@ -301,6 +328,12 @@ class DynamicBatcher:
         run it through the batch former. Returns the planned dispatch
         segments — usually one; several when the cost table says split
         beats pad — and [] only when stopping with an empty queue.
+
+        Shed requests are RESOLVED outside the queue lock: failing a
+        future (and, traced, recording its spans + finishing its trace)
+        under self._cond would stall every concurrent submit() exactly
+        when the server is already shedding — the same hygiene the
+        metrics snapshot applies to its percentile math.
 
         Every popped request is claimed in-flight HERE, before the queue
         lock drops: an observer that sees pending_rows()==0 is then
@@ -318,19 +351,43 @@ class DynamicBatcher:
         its slice of max_batch for requests still worth serving. A pop
         that sheds its entire drain loops back to coalescing instead of
         returning [] (the shutdown signal)."""
-        with self._cond:
-            while True:
-                segments = self._take_batch_locked()
-                if segments is not None:
-                    return segments
+        while True:
+            with self._cond:
+                segments, shed = self._take_batch_locked()
+            self._shed_expired(shed)
+            if segments is not None:
+                return segments
 
-    def _take_batch_locked(self) -> Optional[list[list[_Request]]]:
-        """One coalesce-pop-shed-plan cycle under self._cond; None means
-        'everything popped was shed — coalesce again'."""
+    def _shed_expired(self, shed: list) -> None:
+        """Fail the deadline-expired requests popped by one drain
+        (504-fast), off the queue lock. Spans + trace finish land
+        BEFORE each future resolves — a waiter that has seen the 504
+        also sees the finished trace (the Server-Timing contract)."""
+        for req, t_shed in shed:
+            if self.metrics is not None:
+                self.metrics.record_deadline_shed(req.n)
+            err = DeadlineExceeded(
+                "deadline expired while queued "
+                f"({(t_shed - req.deadline) * 1e3:.1f} ms past); "
+                "shed before dispatch")
+            trace.add_span("queue.wait", req.t_enqueue, t_shed,
+                           rids=(req.rid,), shed=True)
+            trace.add_span("deadline.shed", t_shed, t_shed,
+                           rids=(req.rid,))
+            self._finish_trace(req, error=err)
+            req.future.set_exception(err)
+
+    def _take_batch_locked(self) -> tuple:
+        """One coalesce-pop-plan cycle under self._cond; returns
+        (segments, shed) where segments is None for 'everything popped
+        was shed — coalesce again' and shed holds the (request,
+        pop-stamp) pairs the CALLER must fail once the lock drops."""
+        shed: list = []
         while not self._q and not self._stop:
             self._cond.wait(0.1)
         if not self._q:
-            return []
+            return [], shed
+        t_coalesce = time.monotonic()
         # Sample the effective wait when work is actually in hand
         # (the controller may have moved while the queue was idle).
         wait_s = (self.controller.effective_wait_s()
@@ -350,21 +407,30 @@ class DynamicBatcher:
             req = self._q.popleft()
             self._rows -= req.n
             if req.deadline is not None and now >= req.deadline:
-                if self.metrics is not None:
-                    self.metrics.record_deadline_shed(req.n)
-                req.future.set_exception(DeadlineExceeded(
-                    "deadline expired while queued "
-                    f"({(now - req.deadline) * 1e3:.1f} ms past); "
-                    "shed before dispatch"))
+                # resolved by the caller AFTER the lock drops
+                # (_shed_expired): failing futures and finishing
+                # traces under self._cond would stall every
+                # concurrent submit
+                shed.append((req, now))
                 continue
+            trace.add_span("queue.wait", req.t_enqueue, now,
+                           rids=(req.rid,))
             taken += req.n
             batch.append(req)
         if not batch:
-            return None           # whole drain shed: coalesce again
+            return None, shed     # whole drain shed: coalesce again
+        t_plan = time.monotonic()
         segments = self._plan(batch)
+        tr = trace.active()
+        if tr is not None:
+            rids = [r.rid for r in batch]
+            tr.add_span("batch.coalesce", t_coalesce, now, rids=rids,
+                        rows=taken)
+            tr.add_span("batch.plan", t_plan, time.monotonic(),
+                        rids=rids, segments=len(segments))
         with self._inflight_lock:
             self._inflight += len(segments)
-        return segments
+        return segments, shed
 
     def _plan(self, batch: list[_Request]) -> list[list[_Request]]:
         """The batch former: cut one drain into bucket-shaped dispatch
@@ -397,14 +463,28 @@ class DynamicBatcher:
             return live_fn()
         return getattr(self.engine, "version", None)
 
+    def _finish_trace(self, req: _Request, error=None) -> None:
+        """Close the request's trace (no-op with no tracer). Always
+        called BEFORE the future resolves: a client that has seen its
+        result/error can immediately read the finished trace."""
+        tr = trace.active()
+        if tr is not None:
+            tr.finish_request(req.rid, error=error)
+
     def _engine_dispatch(self, seg: list[_Request]):
         """The one engine.dispatch call site, crossed by every first
         dispatch AND every bisection retry: the `batch.dispatch`
         failpoint fires with the segment's request ids, so a
         request-sticky injected fault (serve/faults.py) fails every
         dispatch containing the poison request — and only those."""
-        failpoint("batch.dispatch", rids=[r.rid for r in seg])
-        return self.engine.dispatch([r.x for r in seg])
+        rids = [r.rid for r in seg]
+        sp = trace.begin_span("batch.dispatch", rids=rids,
+                              rows=sum(r.n for r in seg))
+        try:
+            failpoint("batch.dispatch", rids=rids)
+            return self.engine.dispatch([r.x for r in seg])
+        finally:
+            trace.end_span(sp)
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -417,6 +497,7 @@ class DynamicBatcher:
                 self._slots.release()
                 self._handles.put(None)      # completion shutdown
                 return
+            t_pop = time.monotonic()
             for i, seg in enumerate(segments):
                 if i:
                     # Later segments of a split drain each hold their
@@ -426,6 +507,13 @@ class DynamicBatcher:
                     # bound stays an engine-side invariant under splits.
                     self._slots.acquire()
                 t0 = time.monotonic()
+                if trace.active() is not None:
+                    # pop -> this segment's dispatch begin: plan +
+                    # bookkeeping, plus the window-slot wait for later
+                    # segments of a split drain — without it that gap
+                    # would be unattributed residue
+                    trace.add_span("batch.pending", t_pop, t0,
+                                   rids=[r.rid for r in seg])
                 try:
                     handle = self._engine_dispatch(seg)
                 except Exception as e:   # fail/bisect, keep serving
@@ -436,13 +524,18 @@ class DynamicBatcher:
                     # remaining segments still dispatch
                     self._dispatch_failed(seg, e)
                     continue
+                # t_disp rides the handle queue: the completion thread
+                # synthesizes the dispatched-but-unfetched window as an
+                # `engine.enqueued` span from it (the ISSUE 2 overlap,
+                # visible per batch in the exported trace)
+                t_disp = time.monotonic()
                 with self._inflight_lock:
                     self._dispatched += 1
                     depth = self._dispatched
                 if self.metrics is not None:
-                    self.metrics.record_dispatch(time.monotonic() - t0,
+                    self.metrics.record_dispatch(t_disp - t0,
                                                  inflight=depth)
-                self._handles.put((seg, handle))
+                self._handles.put((seg, handle, t_disp))
 
     def _dispatch_failed(self, seg: list[_Request], e: Exception) -> None:
         """A dispatched segment raised before reaching the device queue.
@@ -492,6 +585,7 @@ class DynamicBatcher:
                 else:
                     self.metrics.record_dispatch_error(len(seg))
             for r in seg:
+                self._finish_trace(r, error=e)
                 r.future.set_exception(e)
             if res is not None and not systemic:
                 res.record_outcome(self._live_version(), ok=False,
@@ -503,17 +597,33 @@ class DynamicBatcher:
         if self.metrics is not None:
             self.metrics.record_bisect_split()
         mid = len(seg) // 2
+        t_split = time.monotonic()
+        trace.add_span("bisect.split", t_split, t_split,
+                       rids=[r.rid for r in seg],
+                       into=[mid, len(seg) - mid])
         pending: deque = deque([seg[:mid], seg[mid:]])
         enqueued = 0
         while pending:
             sub = pending.popleft()
+            sub_err = None
+            sp = trace.begin_span("bisect.dispatch",
+                                  rids=[r.rid for r in sub],
+                                  rows=sum(r.n for r in sub))
             try:
                 handle = self._engine_dispatch(sub)
             except Exception as se:
+                sub_err = se
+                handle = None
+            finally:
+                trace.end_span(sp, error=(type(sub_err).__name__
+                                          if sub_err is not None
+                                          else None))
+            if sub_err is not None:
                 if len(sub) == 1:
                     if self.metrics is not None:
                         self.metrics.record_poison_isolated(sub[0].n)
-                    sub[0].future.set_exception(se)
+                    self._finish_trace(sub[0], error=sub_err)
+                    sub[0].future.set_exception(sub_err)
                     if res is not None:
                         res.record_outcome(self._live_version(),
                                            ok=False)
@@ -521,6 +631,10 @@ class DynamicBatcher:
                     if self.metrics is not None:
                         self.metrics.record_bisect_split()
                     m = len(sub) // 2
+                    t_split = time.monotonic()
+                    trace.add_span("bisect.split", t_split, t_split,
+                                   rids=[r.rid for r in sub],
+                                   into=[m, len(sub) - m])
                     # left half first: FIFO order is preserved across
                     # the completion thread's in-order fetches
                     pending.appendleft(sub[m:])
@@ -535,7 +649,7 @@ class DynamicBatcher:
             if self.metrics is not None:
                 self.metrics.record_bisect_rescued(
                     len(sub), sum(r.n for r in sub))
-            self._handles.put((sub, handle))
+            self._handles.put((sub, handle, time.monotonic()))
             enqueued += 1
         if not enqueued:
             with self._inflight_lock:
@@ -547,12 +661,27 @@ class DynamicBatcher:
             item = self._handles.get()
             if item is None:
                 return
-            batch, handle = item
+            batch, handle, t_disp = item
             t0 = time.monotonic()
+            rids = [r.rid for r in batch]
+            # The in-flight window this batch just spent dispatched-
+            # but-unfetched: device compute overlapping later batches'
+            # staging (ISSUE 2). Synthesized from stamps both threads
+            # already hold, so no span crosses the thread hop open.
+            trace.add_span("engine.enqueued", t_disp, t0, rids=rids,
+                           tid="inflight-window", bucket=handle.bucket)
+            sp = trace.begin_span("engine.fetch", rids=rids,
+                                  bucket=handle.bucket)
             try:
                 logits = self.engine.fetch(handle)
             except Exception as e:   # fan the failure out, keep serving
+                # the span must be recorded (with the error) BEFORE the
+                # traces finish, or the failed requests' exemplars would
+                # miss their fetch stage; the finally's end is then a
+                # no-op (end_span is idempotent)
+                trace.end_span(sp, error=type(e).__name__)
                 for r in batch:
+                    self._finish_trace(r, error=e)
                     r.future.set_exception(e)
                 if self.metrics is not None:
                     self.metrics.record_fetch_error(len(batch))
@@ -568,6 +697,8 @@ class DynamicBatcher:
                     self._dispatched -= 1
                 self._slots.release()
                 continue
+            finally:
+                trace.end_span(sp)
             t_done = time.monotonic()
             version = getattr(handle, "version", None)
             if self.resilience is not None:
@@ -585,8 +716,16 @@ class DynamicBatcher:
                 # set_result, so a waiter that has seen the result also
                 # sees the tag): serve.py reports which model version
                 # actually computed THIS request — under canary routing
-                # that is not necessarily the live version.
+                # that is not necessarily the live version. The trace
+                # finishes first for the same reason: the Server-Timing
+                # breakdown must be readable the moment result() is.
                 r.future.version = version
+                # fan-out wait [fetch done -> this resolve] closed per
+                # request, so attribution's residue stays the true
+                # unexplained remainder, not bookkeeping time
+                trace.add_span("batch.fanout", t_done, time.monotonic(),
+                               rids=(r.rid,))
+                self._finish_trace(r)
                 r.future.set_result(logits[off:off + r.n])
                 off += r.n
             if self.metrics is not None:

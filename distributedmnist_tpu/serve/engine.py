@@ -33,6 +33,7 @@ from distributedmnist_tpu.analysis.locks import make_lock
 from distributedmnist_tpu.analysis.sanitize import (blocking,
                                                     resource_acquire,
                                                     resource_release)
+from distributedmnist_tpu.serve import trace
 from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.utils import (CompileCounter,
                                         enable_compilation_cache, round_up)
@@ -245,29 +246,39 @@ class InferenceEngine:
         # dispatch error never strands a pooled buffer.
         failpoint("engine.dispatch", version=self.version, rows=n,
                   bucket=b)
-        staging = self._staging_take(b)
-        # The checkout is exception-safe: a real backend error in
-        # device_put/dispatch (not the pre-take failpoint) must recycle
-        # the buffer HERE — otherwise the batcher's keep-serving
-        # failure path would bleed one pooled buffer per failed
-        # dispatch, the dispatch-side twin of the PR 5 fetch leak (the
-        # sanitizer's engine.staging balance pins this).
-        dispatched = False
+        # Host staging span (ISSUE 9): pad + device_put + enqueue —
+        # request ids inherit from the batcher's enclosing
+        # batch.dispatch span (thread-local), so the engine needs no
+        # rid plumbing of its own.
+        sp = trace.begin_span("engine.staging", rows=n, bucket=b,
+                              version=self.version)
         try:
-            off = 0
-            for p in parts:
-                staging[off:off + p.shape[0]] = p
-                off += p.shape[0]
-            if n < b:
-                staging[n:] = 0
-            x_dev = jax.device_put(staging, self._x_sharding)
-            logits = self._forward(self.params, x_dev)
-            dispatched = True
+            staging = self._staging_take(b)
+            # The checkout is exception-safe: a real backend error in
+            # device_put/dispatch (not the pre-take failpoint) must
+            # recycle the buffer HERE — otherwise the batcher's
+            # keep-serving failure path would bleed one pooled buffer
+            # per failed dispatch, the dispatch-side twin of the PR 5
+            # fetch leak (the sanitizer's engine.staging balance pins
+            # this).
+            dispatched = False
+            try:
+                off = 0
+                for p in parts:
+                    staging[off:off + p.shape[0]] = p
+                    off += p.shape[0]
+                if n < b:
+                    staging[n:] = 0
+                x_dev = jax.device_put(staging, self._x_sharding)
+                logits = self._forward(self.params, x_dev)
+                dispatched = True
+            finally:
+                if not dispatched:
+                    with self._staging_lock:
+                        self._staging_pool[b].append(staging)
+                    resource_release("engine.staging")
         finally:
-            if not dispatched:
-                with self._staging_lock:
-                    self._staging_pool[b].append(staging)
-                resource_release("engine.staging")
+            trace.end_span(sp)
         return InferenceHandle(logits=logits, n=n, bucket=b,
                                staging=staging, version=self.version,
                                infer_dtype=self.infer_dtype)

@@ -73,6 +73,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from distributedmnist_tpu.analysis.locks import make_condition, make_thread
+from distributedmnist_tpu.serve import trace
 from distributedmnist_tpu.serve.batcher import resolve_max_inflight
 from distributedmnist_tpu.serve.engine import InferenceEngine
 from distributedmnist_tpu.serve.faults import failpoint
@@ -383,6 +384,12 @@ class ReplicaSet:
                              block=False, overflow=True)
             if sib is None:
                 raise
+            # The rescue span names BOTH replicas (ISSUE 9): after an
+            # availability dip, "which replica died and who caught the
+            # batch" is the first question a trace must answer.
+            sp = trace.begin_span("fleet.failover.dispatch",
+                                  from_replica=rep.rid,
+                                  to_replica=sib.rid)
             try:
                 fh = self._dispatch_on(sib, parts, n, bucket, cost_s)
             except Exception as e2:
@@ -390,10 +397,15 @@ class ReplicaSet:
                 self._record(sib, ok=False)
                 # same root-cause rule as the fetch rescue: the batch
                 # is attributed to its PRIMARY failure, the failed
-                # rescue is logged
+                # rescue is logged. The span is errored EXPLICITLY:
+                # what propagates is the original cause, which the
+                # span's own ambient-exception check would not count.
+                trace.end_span(sp, error=type(e2).__name__)
                 log.warning("fleet: rescue dispatch on %s failed too "
                             "(%s)", sib.rid, e2)
                 raise e
+            finally:
+                trace.end_span(sp)
             with self._cond:
                 self._failovers_dispatch += 1
             if self.metrics is not None:
@@ -502,28 +514,39 @@ class ReplicaSet:
         # of something else (say an injected fault matched on the
         # rescuing replica while the primary died of a version fault)
         # is a secondary event that belongs in the log, not in the
-        # batch's attribution.
+        # batch's attribution. The rescue span names both replicas
+        # (ISSUE 9) and times the whole redispatch+fetch, so a
+        # rescued request's tail is blamed on the rescue, not on the
+        # enclosing fetch stage.
+        sp = trace.begin_span("fleet.failover.fetch",
+                              from_replica=failed.rid,
+                              to_replica=sib.rid)
         try:
-            rescued = self._dispatch_on(sib, fh.x, fh.n, fh.bucket,
-                                        fh.cost_s)
-        except Exception as e2:
-            self._release(sib, fh.cost_s)
-            self._record(sib, ok=False)
-            log.warning("fleet: rescue dispatch on %s failed too (%s)",
-                        sib.rid, e2)
-            raise cause
-        log.warning("fleet: fetch failover %s -> %s (%s)",
-                    failed.rid, sib.rid, cause)
-        try:
-            out = self._fetch_on(sib, rescued.inner, rescued.version,
-                                 fh.n)
-        except Exception as e2:
-            self._release(sib, fh.cost_s)
-            self._record(sib, ok=False)
-            self._drain_abandoned(sib, rescued.inner)
-            log.warning("fleet: rescue fetch on %s failed too (%s)",
-                        sib.rid, e2)
-            raise cause
+            try:
+                rescued = self._dispatch_on(sib, fh.x, fh.n, fh.bucket,
+                                            fh.cost_s)
+            except Exception as e2:
+                self._release(sib, fh.cost_s)
+                self._record(sib, ok=False)
+                trace.end_span(sp, error=type(e2).__name__)
+                log.warning("fleet: rescue dispatch on %s failed too "
+                            "(%s)", sib.rid, e2)
+                raise cause
+            log.warning("fleet: fetch failover %s -> %s (%s)",
+                        failed.rid, sib.rid, cause)
+            try:
+                out = self._fetch_on(sib, rescued.inner, rescued.version,
+                                     fh.n)
+            except Exception as e2:
+                self._release(sib, fh.cost_s)
+                self._record(sib, ok=False)
+                self._drain_abandoned(sib, rescued.inner)
+                trace.end_span(sp, error=type(e2).__name__)
+                log.warning("fleet: rescue fetch on %s failed too (%s)",
+                            sib.rid, e2)
+                raise cause
+        finally:
+            trace.end_span(sp)
         self._release(sib, fh.cost_s)
         # The sibling's health is scored on ITS OWN service time (the
         # rescue dispatch onward): charging the dead primary's delay to
@@ -564,78 +587,104 @@ class ReplicaSet:
         sibling), so the two short-lived threads per hedge are noise."""
         cv = make_condition("fleet.hedge")
         results: dict = {}            # tag -> (ok, value) in arrival order
+        winner: dict = {}             # the hedge span's winner tag
 
         def finish(tag, ok, value):
             with cv:
                 results[tag] = (ok, value)
                 cv.notify_all()
 
-        def run_primary():
-            try:
-                out = self._fetch_on(rep, fh.inner, fh.version, fh.n)
-            except Exception as e:
-                self._release(rep, fh.cost_s)
-                self._record(rep, ok=False)
-                self._drain_abandoned(rep, fh.inner)
-                finish("primary", False, e)
-                return
-            self._release(rep, fh.cost_s)
-            self._record(rep, ok=True,
-                         latency_s=time.monotonic() - fh.t_dispatch)
-            finish("primary", True, out)
+        # The race's parent span plus one child per arm (ISSUE 9): the
+        # arms run on their own threads, so they take an explicit ctx
+        # ref instead of inheriting from a thread-local stack.
+        hsp = trace.begin_span("fleet.hedge", primary=rep.rid,
+                               duplicate=sib.rid, bucket=fh.bucket)
+        try:
+            ctx = trace.current()
 
-        def run_hedge():
-            try:
-                dup = self._dispatch_on(sib, fh.x, fh.n, fh.bucket,
-                                        fh.cost_s)
-            except Exception as e:
-                self._release(sib, fh.cost_s)
-                self._record(sib, ok=False)
-                finish("hedge", False, e)
-                return
-            try:
-                out = self._fetch_on(sib, dup.inner, dup.version, fh.n)
-            except Exception as e:
-                self._release(sib, fh.cost_s)
-                self._record(sib, ok=False)
-                self._drain_abandoned(sib, dup.inner)
-                finish("hedge", False, e)
-                return
-            self._release(sib, fh.cost_s)
-            # scored on the hedge's own dispatch-to-result time, not
-            # the overdue primary's elapsed window (same attribution
-            # rule as the failover rescue)
-            self._record(sib, ok=True,
-                         latency_s=time.monotonic() - dup.t_dispatch)
-            finish("hedge", True, (out, dup.version, sib.rid,
-                                   dup.infer_dtype))
+            def run_primary():
+                psp = trace.begin_span("fleet.hedge.primary", ctx=ctx,
+                                       replica=rep.rid)
+                try:
+                    try:
+                        out = self._fetch_on(rep, fh.inner, fh.version,
+                                             fh.n)
+                    except Exception as e:
+                        self._release(rep, fh.cost_s)
+                        self._record(rep, ok=False)
+                        self._drain_abandoned(rep, fh.inner)
+                        finish("primary", False, e)
+                        return
+                    self._release(rep, fh.cost_s)
+                    self._record(rep, ok=True,
+                                 latency_s=(time.monotonic()
+                                            - fh.t_dispatch))
+                    finish("primary", True, out)
+                finally:
+                    trace.end_span(psp)
 
-        with self._cond:
-            self._hedges += 1
-        for target in (run_primary, run_hedge):
-            make_thread(target=target, name="serve-hedge",
-                        daemon=True).start()
-        with cv:
-            while True:
-                for tag, (ok, value) in results.items():
-                    if ok:
-                        hedge_won = tag == "hedge"
-                        if hedge_won:
-                            with self._cond:
-                                self._hedge_wins += 1
-                            out, version, rid, dtype = value
-                            fh.replica, fh.version = rid, version
-                            fh.infer_dtype = dtype
-                        else:
-                            out = value
+            def run_hedge():
+                dsp = trace.begin_span("fleet.hedge.duplicate", ctx=ctx,
+                                       replica=sib.rid)
+                try:
+                    try:
+                        dup = self._dispatch_on(sib, fh.x, fh.n,
+                                                fh.bucket, fh.cost_s)
+                    except Exception as e:
+                        self._release(sib, fh.cost_s)
+                        self._record(sib, ok=False)
+                        finish("hedge", False, e)
+                        return
+                    try:
+                        out = self._fetch_on(sib, dup.inner, dup.version,
+                                             fh.n)
+                    except Exception as e:
+                        self._release(sib, fh.cost_s)
+                        self._record(sib, ok=False)
+                        self._drain_abandoned(sib, dup.inner)
+                        finish("hedge", False, e)
+                        return
+                    self._release(sib, fh.cost_s)
+                    # scored on the hedge's own dispatch-to-result
+                    # time, not the overdue primary's elapsed window
+                    # (same attribution rule as the failover rescue)
+                    self._record(sib, ok=True,
+                                 latency_s=(time.monotonic()
+                                            - dup.t_dispatch))
+                    finish("hedge", True, (out, dup.version, sib.rid,
+                                           dup.infer_dtype))
+                finally:
+                    trace.end_span(dsp)
+
+            with self._cond:
+                self._hedges += 1
+            for target in (run_primary, run_hedge):
+                make_thread(target=target, name="serve-hedge",
+                            daemon=True).start()
+            with cv:
+                while True:
+                    for tag, (ok, value) in results.items():
+                        if ok:
+                            hedge_won = tag == "hedge"
+                            winner["who"] = tag
+                            if hedge_won:
+                                with self._cond:
+                                    self._hedge_wins += 1
+                                out, version, rid, dtype = value
+                                fh.replica, fh.version = rid, version
+                                fh.infer_dtype = dtype
+                            else:
+                                out = value
+                            if self.metrics is not None:
+                                self.metrics.record_hedge(win=hedge_won)
+                            return out
+                    if len(results) == 2:   # both failed
                         if self.metrics is not None:
-                            self.metrics.record_hedge(win=hedge_won)
-                        return out
-                if len(results) == 2:   # both failed
-                    if self.metrics is not None:
-                        self.metrics.record_hedge(win=False)
-                    raise results["primary"][1]
-                cv.wait()
+                            self.metrics.record_hedge(win=False)
+                        raise results["primary"][1]
+                    cv.wait()
+        finally:
+            trace.end_span(hsp, winner=winner.get("who"))
 
     def infer(self, x) -> np.ndarray:
         return self.fetch(self.dispatch(x))

@@ -86,6 +86,7 @@ class ServeMetrics:
             self._dispatch_error_requests = 0
             self._fetch_error_requests = 0
             self._breaker_trips = 0
+            self._breaker_trips_by_version: dict[str, int] = {}
             self._rollbacks = 0
             self._last_rollback = None       # {"from", "to", "at"}
             # fleet accounting (ISSUE 6): per-replica batch populations
@@ -248,8 +249,16 @@ class ServeMetrics:
             self._fetch_error_requests += requests
 
     def record_breaker_trip(self, version: str) -> None:
+        """One circuit-breaker trip, attributed to the version whose
+        failure window crossed the ratio (the argument used to be
+        silently dropped — ISSUE 9 satellite): after an incident,
+        WHICH version kept tripping is the question, exactly as it is
+        for replicas (`_replica_trips`)."""
         with self._lock:
             self._breaker_trips += 1
+            if version is not None:
+                self._breaker_trips_by_version[version] = (
+                    self._breaker_trips_by_version.get(version, 0) + 1)
 
     def record_rollback(self, from_version: str, to_version: str) -> None:
         """The breaker's trip demoted `from_version` and auto-promoted
@@ -295,111 +304,169 @@ class ServeMetrics:
     # -- reporting ---------------------------------------------------------
 
     def snapshot(self) -> dict:
+        # Copy raw state under the lock; compute percentiles AFTER
+        # releasing it (ISSUE 9 satellite). np.quantile over an
+        # up-to-100k-sample deque costs milliseconds — holding the
+        # metrics lock through it stalled every recording hook on the
+        # dispatch/completion hot path whenever /metrics was polled.
+        # The deque copies are O(n) pointer copies (cheap); the math
+        # runs on thread-private lists.
         with self._lock:
             elapsed = max(time.monotonic() - self._t0, 1e-9)
-            lat_ms = {k: (round(v * 1e3, 3) if v is not None else None)
-                      for k, v in percentiles(list(self._lat_s)).items()}
-            occupancy = {
-                str(b): {"batches": n, "rows": rows,
-                         "occupancy": round(rows / (n * b), 4)}
-                for b, (n, rows) in sorted(self._occupancy.items())}
-            return {
-                "window_s": round(elapsed, 3),
+            lat = list(self._lat_s)
+            staging = list(self._staging_s)
+            fetch = list(self._fetch_s)
+            occupancy_raw = {b: (n, rows) for b, (n, rows)
+                             in self._occupancy.items()}
+            by_version_raw = {
+                v: {"requests": s["requests"], "rows": s["rows"],
+                    "batches": s["batches"], "lat": list(s["lat"])}
+                for v, s in self._by_version.items()}
+            shadow_raw = {pair: dict(s)
+                          for pair, s in self._shadow.items()}
+            c = {
                 "requests": self._requests,
                 "rows": self._rows,
                 "batches": self._batches,
-                "requests_per_sec": round(self._requests / elapsed, 2),
-                "rows_per_sec": round(self._rows / elapsed, 2),
-                "latency_ms": lat_ms,
-                "batch_occupancy": occupancy,
-                # The scheduler's report card: executed bucket slots vs
-                # real rows (their ratio is the FLOP fraction burned on
-                # padding), the per-bucket dispatch histogram, and the
-                # effective-wait operating point.
                 "dispatched_rows": self._dispatched_rows,
                 "padded_rows": self._padded_rows,
-                "padding_waste_ratio": (
-                    round(self._padded_rows / self._dispatched_rows, 4)
-                    if self._dispatched_rows else None),
-                "bucket_dispatches": {
-                    str(b): n
-                    for b, (n, _) in sorted(self._occupancy.items())},
-                "effective_wait_us": {
-                    "last": (round(self._wait_last_s * 1e6, 1)
-                             if self._wait_n else None),
-                    "mean": (round(self._wait_sum_s / self._wait_n * 1e6,
-                                   1)
-                             if self._wait_n else None),
-                },
-                "mean_rows_per_batch": (
-                    round(self._rows / self._batches, 2)
-                    if self._batches else None),
-                "queue_depth_mean": (
-                    round(self._depth_sum / self._batches, 2)
-                    if self._batches else None),
-                "queue_depth_max": self._depth_max,
+                "wait_last_s": self._wait_last_s,
+                "wait_sum_s": self._wait_sum_s,
+                "wait_n": self._wait_n,
+                "depth_sum": self._depth_sum,
+                "depth_max": self._depth_max,
                 "rejected_requests": self._rejected_requests,
                 "rejected_rows": self._rejected_rows,
-                "staging_ms": {
-                    k: (round(v * 1e3, 3) if v is not None else None)
-                    for k, v in percentiles(
-                        list(self._staging_s)).items()},
-                "fetch_ms": {
-                    k: (round(v * 1e3, 3) if v is not None else None)
-                    for k, v in percentiles(list(self._fetch_s)).items()},
-                "inflight_mean": (
-                    round(self._inflight_sum / self._dispatches, 2)
-                    if self._dispatches else None),
+                "inflight_sum": self._inflight_sum,
                 "inflight_max": self._inflight_max,
-                "by_version": {
-                    v: {"requests": s["requests"], "rows": s["rows"],
-                        "batches": s["batches"],
-                        "latency_ms": {
-                            k: (round(x * 1e3, 3) if x is not None
-                                else None)
-                            for k, x in percentiles(
-                                list(s["lat"])).items()}}
-                    for v, s in sorted(self._by_version.items())},
-                "shadow": {
-                    pair: {**s,
-                           "agreement": (round(s["agree_rows"]
-                                               / s["rows"], 4)
-                                         if s["rows"] else None),
-                           "max_abs_diff": round(s["max_abs_diff"], 6)}
-                    for pair, s in sorted(self._shadow.items())},
+                "dispatches": self._dispatches,
                 "shadow_errors": self._shadow_errors,
                 "shadow_dropped": self._shadow_dropped,
-                "by_replica": {r: dict(s) for r, s in
-                               sorted(self._by_replica.items())},
-                "by_dtype": {d: dict(s) for d, s in
-                             sorted(self._by_dtype.items())},
-                "fleet": {
-                    "failovers": dict(self._failovers),
-                    "failovers_total": sum(self._failovers.values()),
-                    "last_failover": self._last_failover,
-                    "hedges": self._hedges,
-                    "hedge_wins": self._hedge_wins,
-                    "replica_trips": sum(self._replica_trips.values()),
-                    "replica_trips_by_replica": dict(self._replica_trips),
-                },
-                "resilience": {
-                    "deadline_shed_requests": self._deadline_shed_requests,
-                    "deadline_shed_rows": self._deadline_shed_rows,
-                    "bisect_splits": self._bisect_splits,
-                    "poison_isolated_requests":
-                        self._poison_isolated_requests,
-                    "poison_isolated_rows": self._poison_isolated_rows,
-                    "bisect_rescued_requests":
-                        self._bisect_rescued_requests,
-                    "bisect_rescued_rows": self._bisect_rescued_rows,
-                    "dispatch_error_requests":
-                        self._dispatch_error_requests,
-                    "fetch_error_requests": self._fetch_error_requests,
-                    "breaker_trips": self._breaker_trips,
-                    "rollbacks": self._rollbacks,
-                    "last_rollback": self._last_rollback,
-                },
+                "by_replica": {r: dict(s)
+                               for r, s in self._by_replica.items()},
+                "by_dtype": {d: dict(s)
+                             for d, s in self._by_dtype.items()},
+                "failovers": dict(self._failovers),
+                "last_failover": self._last_failover,
+                "hedges": self._hedges,
+                "hedge_wins": self._hedge_wins,
+                "replica_trips": dict(self._replica_trips),
+                "deadline_shed_requests": self._deadline_shed_requests,
+                "deadline_shed_rows": self._deadline_shed_rows,
+                "bisect_splits": self._bisect_splits,
+                "poison_isolated_requests":
+                    self._poison_isolated_requests,
+                "poison_isolated_rows": self._poison_isolated_rows,
+                "bisect_rescued_requests": self._bisect_rescued_requests,
+                "bisect_rescued_rows": self._bisect_rescued_rows,
+                "dispatch_error_requests": self._dispatch_error_requests,
+                "fetch_error_requests": self._fetch_error_requests,
+                "breaker_trips": self._breaker_trips,
+                "breaker_trips_by_version":
+                    dict(self._breaker_trips_by_version),
+                "rollbacks": self._rollbacks,
+                "last_rollback": self._last_rollback,
             }
+        lat_ms = {k: (round(v * 1e3, 3) if v is not None else None)
+                  for k, v in percentiles(lat).items()}
+        occupancy = {
+            str(b): {"batches": n, "rows": rows,
+                     "occupancy": round(rows / (n * b), 4)}
+            for b, (n, rows) in sorted(occupancy_raw.items())}
+        return {
+            "window_s": round(elapsed, 3),
+            "requests": c["requests"],
+            "rows": c["rows"],
+            "batches": c["batches"],
+            "requests_per_sec": round(c["requests"] / elapsed, 2),
+            "rows_per_sec": round(c["rows"] / elapsed, 2),
+            "latency_ms": lat_ms,
+            "batch_occupancy": occupancy,
+            # The scheduler's report card: executed bucket slots vs
+            # real rows (their ratio is the FLOP fraction burned on
+            # padding), the per-bucket dispatch histogram, and the
+            # effective-wait operating point.
+            "dispatched_rows": c["dispatched_rows"],
+            "padded_rows": c["padded_rows"],
+            "padding_waste_ratio": (
+                round(c["padded_rows"] / c["dispatched_rows"], 4)
+                if c["dispatched_rows"] else None),
+            "bucket_dispatches": {
+                str(b): n
+                for b, (n, _) in sorted(occupancy_raw.items())},
+            "effective_wait_us": {
+                "last": (round(c["wait_last_s"] * 1e6, 1)
+                         if c["wait_n"] else None),
+                "mean": (round(c["wait_sum_s"] / c["wait_n"] * 1e6, 1)
+                         if c["wait_n"] else None),
+            },
+            "mean_rows_per_batch": (
+                round(c["rows"] / c["batches"], 2)
+                if c["batches"] else None),
+            "queue_depth_mean": (
+                round(c["depth_sum"] / c["batches"], 2)
+                if c["batches"] else None),
+            "queue_depth_max": c["depth_max"],
+            "rejected_requests": c["rejected_requests"],
+            "rejected_rows": c["rejected_rows"],
+            "staging_ms": {
+                k: (round(v * 1e3, 3) if v is not None else None)
+                for k, v in percentiles(staging).items()},
+            "fetch_ms": {
+                k: (round(v * 1e3, 3) if v is not None else None)
+                for k, v in percentiles(fetch).items()},
+            "inflight_mean": (
+                round(c["inflight_sum"] / c["dispatches"], 2)
+                if c["dispatches"] else None),
+            "inflight_max": c["inflight_max"],
+            "by_version": {
+                v: {"requests": s["requests"], "rows": s["rows"],
+                    "batches": s["batches"],
+                    "latency_ms": {
+                        k: (round(x * 1e3, 3) if x is not None
+                            else None)
+                        for k, x in percentiles(s["lat"]).items()}}
+                for v, s in sorted(by_version_raw.items())},
+            "shadow": {
+                pair: {**s,
+                       "agreement": (round(s["agree_rows"]
+                                           / s["rows"], 4)
+                                     if s["rows"] else None),
+                       "max_abs_diff": round(s["max_abs_diff"], 6)}
+                for pair, s in sorted(shadow_raw.items())},
+            "shadow_errors": c["shadow_errors"],
+            "shadow_dropped": c["shadow_dropped"],
+            "by_replica": {r: s for r, s in
+                           sorted(c["by_replica"].items())},
+            "by_dtype": {d: s for d, s in
+                         sorted(c["by_dtype"].items())},
+            "fleet": {
+                "failovers": c["failovers"],
+                "failovers_total": sum(c["failovers"].values()),
+                "last_failover": c["last_failover"],
+                "hedges": c["hedges"],
+                "hedge_wins": c["hedge_wins"],
+                "replica_trips": sum(c["replica_trips"].values()),
+                "replica_trips_by_replica": c["replica_trips"],
+            },
+            "resilience": {
+                "deadline_shed_requests": c["deadline_shed_requests"],
+                "deadline_shed_rows": c["deadline_shed_rows"],
+                "bisect_splits": c["bisect_splits"],
+                "poison_isolated_requests":
+                    c["poison_isolated_requests"],
+                "poison_isolated_rows": c["poison_isolated_rows"],
+                "bisect_rescued_requests": c["bisect_rescued_requests"],
+                "bisect_rescued_rows": c["bisect_rescued_rows"],
+                "dispatch_error_requests": c["dispatch_error_requests"],
+                "fetch_error_requests": c["fetch_error_requests"],
+                "breaker_trips": c["breaker_trips"],
+                "breaker_trips_by_version":
+                    c["breaker_trips_by_version"],
+                "rollbacks": c["rollbacks"],
+                "last_rollback": c["last_rollback"],
+            },
+        }
 
     def record(self) -> dict:
         """The supervise-acceptable heartbeat record: a JSON-able dict
@@ -408,3 +475,148 @@ class ServeMetrics:
 
     def heartbeat_line(self) -> str:
         return MetricsLogger.summary_line(self.record())
+
+
+# -- Prometheus text exposition (ISSUE 9 satellite) ------------------------
+
+# The p-keys utils.percentiles emits, as Prometheus quantile labels.
+_PROM_QUANTILES = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
+
+
+def _prom_escape(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _prom_line(name: str, labels: dict, value) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_prom_escape(v)}"'
+                        for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {value}"
+    return f"{name} {value}"
+
+
+def prometheus_exposition(snapshot: dict,
+                          trace_stages: dict = None,
+                          gauges: dict = None) -> str:
+    """Flatten a ServeMetrics snapshot() into Prometheus text format
+    (`GET /metrics?format=prometheus`, or an `Accept: text/plain`
+    scrape): stably-named counters/gauges/summaries with `# TYPE`
+    lines, derived from the SAME snapshot the JSON surface serves — a
+    scrape surface for the fleet story without a second accounting
+    path. `trace_stages` (Tracer.snapshot()["stages"], optional) adds
+    the per-stage duration histograms derived from the ISSUE 9 spans;
+    `gauges` adds point-in-time pipeline gauges (queue depth, in-flight
+    window) the snapshot itself does not carry. None-valued samples
+    (empty percentile windows) are skipped, never emitted as 0."""
+    out: list[str] = []
+
+    def emit(name: str, mtype: str, samples) -> None:
+        rows = [(labels, v) for labels, v in samples if v is not None]
+        if not rows:
+            return
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, v in rows:
+            out.append(_prom_line(name, labels, v))
+
+    def summary(name: str, pct: dict, count=None) -> None:
+        rows = [({"quantile": q}, pct.get(p))
+                for p, q in _PROM_QUANTILES.items()]
+        if all(v is None for _, v in rows):
+            return
+        emit(name, "summary", rows)
+        if count is not None:
+            out.append(_prom_line(name + "_count", {}, count))
+
+    s = snapshot
+    res = s.get("resilience", {})
+    fleet = s.get("fleet", {})
+    emit("dmnist_serve_requests_total", "counter",
+         [({}, s.get("requests"))])
+    emit("dmnist_serve_rows_total", "counter", [({}, s.get("rows"))])
+    emit("dmnist_serve_batches_total", "counter",
+         [({}, s.get("batches"))])
+    emit("dmnist_serve_rejected_requests_total", "counter",
+         [({}, s.get("rejected_requests"))])
+    emit("dmnist_serve_rejected_rows_total", "counter",
+         [({}, s.get("rejected_rows"))])
+    emit("dmnist_serve_dispatched_rows_total", "counter",
+         [({}, s.get("dispatched_rows"))])
+    emit("dmnist_serve_padded_rows_total", "counter",
+         [({}, s.get("padded_rows"))])
+    emit("dmnist_serve_requests_per_second", "gauge",
+         [({}, s.get("requests_per_sec"))])
+    emit("dmnist_serve_rows_per_second", "gauge",
+         [({}, s.get("rows_per_sec"))])
+    emit("dmnist_serve_padding_waste_ratio", "gauge",
+         [({}, s.get("padding_waste_ratio"))])
+    emit("dmnist_serve_inflight_max", "gauge",
+         [({}, s.get("inflight_max"))])
+    emit("dmnist_serve_queue_depth_max", "gauge",
+         [({}, s.get("queue_depth_max"))])
+    summary("dmnist_serve_latency_ms", s.get("latency_ms", {}),
+            count=s.get("requests"))
+    summary("dmnist_serve_staging_ms", s.get("staging_ms", {}))
+    summary("dmnist_serve_fetch_ms", s.get("fetch_ms", {}))
+    emit("dmnist_serve_bucket_dispatches_total", "counter",
+         [({"bucket": b}, n)
+          for b, n in s.get("bucket_dispatches", {}).items()])
+    emit("dmnist_serve_version_requests_total", "counter",
+         [({"version": v}, vs.get("requests"))
+          for v, vs in s.get("by_version", {}).items()])
+    emit("dmnist_serve_replica_batches_total", "counter",
+         [({"replica": r}, rs.get("batches"))
+          for r, rs in s.get("by_replica", {}).items()])
+    emit("dmnist_serve_dtype_batches_total", "counter",
+         [({"dtype": d}, ds.get("batches"))
+          for d, ds in s.get("by_dtype", {}).items()])
+    emit("dmnist_serve_shadow_errors_total", "counter",
+         [({}, s.get("shadow_errors"))])
+    # resilience (ISSUE 5) + fleet (ISSUE 6) counters
+    emit("dmnist_serve_deadline_shed_requests_total", "counter",
+         [({}, res.get("deadline_shed_requests"))])
+    emit("dmnist_serve_bisect_splits_total", "counter",
+         [({}, res.get("bisect_splits"))])
+    emit("dmnist_serve_poison_isolated_requests_total", "counter",
+         [({}, res.get("poison_isolated_requests"))])
+    emit("dmnist_serve_bisect_rescued_requests_total", "counter",
+         [({}, res.get("bisect_rescued_requests"))])
+    emit("dmnist_serve_dispatch_error_requests_total", "counter",
+         [({}, res.get("dispatch_error_requests"))])
+    emit("dmnist_serve_fetch_error_requests_total", "counter",
+         [({}, res.get("fetch_error_requests"))])
+    emit("dmnist_serve_breaker_trips_total", "counter",
+         [({}, res.get("breaker_trips"))])
+    emit("dmnist_serve_breaker_version_trips_total", "counter",
+         [({"version": v}, n) for v, n in
+          res.get("breaker_trips_by_version", {}).items()])
+    emit("dmnist_serve_rollbacks_total", "counter",
+         [({}, res.get("rollbacks"))])
+    emit("dmnist_serve_failovers_total", "counter",
+         [({"kind": k}, n)
+          for k, n in fleet.get("failovers", {}).items()])
+    emit("dmnist_serve_hedges_total", "counter",
+         [({}, fleet.get("hedges"))])
+    emit("dmnist_serve_hedge_wins_total", "counter",
+         [({}, fleet.get("hedge_wins"))])
+    emit("dmnist_serve_replica_trips_total", "counter",
+         [({"replica": r}, n) for r, n in
+          fleet.get("replica_trips_by_replica", {}).items()])
+    for name, value in (gauges or {}).items():
+        emit(f"dmnist_serve_{name}", "gauge", [({}, value)])
+    # Per-stage duration histograms derived from the ISSUE 9 spans —
+    # cumulative buckets per the Prometheus histogram contract.
+    if trace_stages:
+        name = "dmnist_serve_stage_duration_ms"
+        out.append(f"# TYPE {name} histogram")
+        for stage, h in sorted(trace_stages.items()):
+            cum = 0
+            for le, count in h["buckets"].items():
+                cum += count
+                out.append(_prom_line(name + "_bucket",
+                                      {"stage": stage, "le": le}, cum))
+            out.append(_prom_line(name + "_sum", {"stage": stage},
+                                  h["sum_ms"]))
+            out.append(_prom_line(name + "_count", {"stage": stage},
+                                  h["count"]))
+    return "\n".join(out) + "\n"

@@ -46,6 +46,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from distributedmnist_tpu.analysis.locks import make_lock, make_thread
+from distributedmnist_tpu.serve import trace
 from distributedmnist_tpu.serve.engine import InferenceEngine
 from distributedmnist_tpu.serve.faults import failpoint
 
@@ -297,6 +298,8 @@ class Router:
                 if self.metrics is not None:
                     self.metrics.record_shadow_drop()
             else:
+                sp = trace.begin_span("router.shadow",
+                                      version=shadow.version)
                 try:
                     # Fault-injection seam for the candidate fan-out
                     # (serve/faults.py): an injected shadow fault must
@@ -306,15 +309,18 @@ class Router:
                     rh.shadow_handle = shadow.engine.dispatch(x)
                     rh.shadow_engine = shadow.engine
                     rh.shadow_version = shadow.version
-                except Exception:
+                except Exception as se:
                     # A broken candidate must never take down live
                     # traffic.
                     log.exception("shadow dispatch to %s failed",
                                   shadow.version)
+                    trace.end_span(sp, error=type(se).__name__)
                     with self._shadow_pending_lock:
                         self._shadow_pending -= 1
                     if self.metrics is not None:
                         self.metrics.record_shadow_error()
+                finally:
+                    trace.end_span(sp)
         return rh
 
     def fetch(self, rh: RoutedHandle) -> np.ndarray:

@@ -27,7 +27,18 @@ Two modes:
                          (effective coalescing wait + in-flight depth
                          at the measured batch service time)
     GET  /metrics        current ServeMetrics snapshot (JSON), incl.
-                         per-version populations + shadow comparisons
+                         per-version populations + shadow comparisons;
+                         ?format=prometheus (or an Accept: text/plain
+                         scrape) returns the Prometheus text
+                         exposition — stable # TYPE'd counters/gauges/
+                         summaries and, under --serve-trace, per-stage
+                         duration histograms
+    GET  /trace          (--serve-trace) Chrome trace-event JSON of the
+                         retained request traces — loads directly in
+                         chrome://tracing / Perfetto. Every /predict
+                         response then carries X-Trace-Id, and a
+                         request sent with `X-Server-Timing: 1` gets a
+                         Server-Timing stage breakdown on its response
     GET  /healthz        real state: {"ok", "state":
                          warming|running|draining, "live_version",
                          "pending_rows", "inflight_batches",
@@ -94,6 +105,14 @@ PARITY.md); a refused variant stays off traffic with its reason in
 GET /models. auto serves the cheapest parity-passing variant by the
 warmup-measured bucket cost tables. /healthz and GET /models report
 live_infer_dtype so an operator can tell which precision is live.
+
+Tracing (ISSUE 9, serve/trace.py): --serve-trace installs the
+per-request span tracer. Each request's path (queue wait, staging,
+device window, fetch, rescues, bisect retries) is recorded as a span
+tree; errored and over-SLO requests are ALWAYS retained (head sampling
+--serve-trace-sample only thins the OK traces), the ring is bounded at
+--serve-trace-capacity, and the same spans feed the /metrics per-stage
+histograms. Default off: every woven hook is one None check.
 
 Replica fleet (ISSUE 6, serve/fleet.py): --serve-replicas N puts N
 engine replicas (mesh slices when devices divide evenly, logical
@@ -306,7 +325,9 @@ def _http_serve(batcher, metrics, registry, state, port: int,
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from distributedmnist_tpu.serve import (DeadlineExceeded, NoLiveModel,
-                                            Rejected)
+                                            Rejected,
+                                            prometheus_exposition)
+    from distributedmnist_tpu.serve import trace as trace_lib
 
     max_body = registry.factory.max_batch * IMAGE_BYTES
     # The replica fleet, when serving one (--serve-replicas >= 2):
@@ -342,6 +363,17 @@ def _http_serve(batcher, metrics, registry, state, port: int,
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, text: str,
+                       content_type: str = "text/plain; "
+                                           "version=0.0.4; "
+                                           "charset=utf-8") -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def _json_body(self) -> dict:
             length = int(self.headers.get("Content-Length", 0))
             if length == 0:
@@ -352,11 +384,46 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 raise ValueError("body must be a JSON object")
             return body
 
+        def _wants_prometheus(self) -> bool:
+            """`?format=prometheus` or a text/plain Accept (the
+            standard scrape shape) selects the text exposition; the
+            JSON snapshot stays the default for humans and tests."""
+            from urllib.parse import parse_qs, urlsplit
+            q = parse_qs(urlsplit(self.path).query)
+            if q.get("format", [None])[0] == "prometheus":
+                return True
+            accept = self.headers.get("Accept", "")
+            return ("text/plain" in accept
+                    and "application/json" not in accept)
+
         def do_GET(self):
             if self.path == "/healthz":
                 code, payload = state.healthz(registry, batcher)
                 self._send(code, payload)
-            elif self.path == "/metrics":
+            elif self.path == "/trace" or self.path.startswith("/trace?"):
+                tracer = trace_lib.active()
+                if tracer is None:
+                    self._send(409, {
+                        "error": "tracing is not enabled; restart with "
+                                 "--serve-trace"})
+                else:
+                    # Chrome trace-event JSON: loads directly in
+                    # chrome://tracing / Perfetto.
+                    self._send(200, tracer.export_chrome())
+            elif (self.path == "/metrics"
+                  or self.path.startswith("/metrics?")):
+                if self._wants_prometheus():
+                    tracer = trace_lib.active()
+                    self._send_text(200, prometheus_exposition(
+                        metrics.snapshot(),
+                        trace_stages=(tracer.snapshot()["stages"]
+                                      if tracer is not None else None),
+                        gauges={
+                            "pending_rows": batcher.pending_rows(),
+                            "inflight_batches":
+                                batcher.inflight_batches(),
+                        }))
+                    return
                 # The full ServeMetrics snapshot PLUS point-in-time
                 # pipeline gauges and the adaptive controller's state —
                 # the operator's one-stop view, so nobody has to scrape
@@ -383,6 +450,12 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # hedge counters (None on a single-replica server)
                 payload["fleet"] = (fleet.snapshot()
                                     if fleet is not None else None)
+                # the tracer's counters + per-stage duration
+                # histograms, derived from the same spans GET /trace
+                # exports (None without --serve-trace)
+                tracer = trace_lib.active()
+                payload["trace"] = (tracer.snapshot()
+                                    if tracer is not None else None)
                 self._send(200, payload)
             elif self.path == "/models":
                 self._send(200, registry.describe())
@@ -566,6 +639,28 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 deadline_s = time.monotonic() + budget_s
             raw = self.rfile.read(length)
             x = np.frombuffer(raw, np.uint8).reshape(-1, IMAGE_BYTES)
+            fut = None
+
+            def trace_headers() -> dict:
+                """X-Trace-Id on every response whose request entered
+                the pipeline (ISSUE 9), plus an opt-in Server-Timing
+                stage breakdown (send `X-Server-Timing: 1`) — readable
+                because the batcher finishes a trace BEFORE resolving
+                its future."""
+                tid = getattr(fut, "trace_id", None)
+                if tid is None:
+                    return {}
+                hdrs = {"X-Trace-Id": tid}
+                # explicit opt-IN only: "X-Server-Timing: 0" must not
+                # enable the breakdown just by being a truthy string
+                opt = (self.headers.get("X-Server-Timing") or "")
+                if opt.strip().lower() in ("1", "true", "yes", "on"):
+                    tracer = trace_lib.active()
+                    st = (tracer.server_timing(tid)
+                          if tracer is not None else None)
+                    if st:
+                        hdrs["Server-Timing"] = st
+                return hdrs
             try:
                 # Bounded wait: if the dispatch pipeline wedges, this
                 # handler thread must come back (504) rather than be
@@ -584,34 +679,39 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # semantics as overload — the client should retry, and
                 # /healthz says why
                 self._send(503, {"error": "no warmed model is live yet"},
-                           extra=retry_after())
+                           extra={**retry_after(), **trace_headers()})
                 return
             except DeadlineExceeded as e:
                 # shed before dispatch: the batcher spent zero device
                 # work on this request (or refused it at submit)
-                self._send(504, {"error": str(e)}, extra=retry_after())
+                self._send(504, {"error": str(e)},
+                           extra={**retry_after(), **trace_headers()})
                 return
             except concurrent.futures.TimeoutError:
                 if (deadline_s is not None
                         and time.monotonic() >= deadline_s):
                     self._send(504, {"error": "deadline expired while "
                                               "awaiting inference"},
-                               extra=retry_after())
+                               extra={**retry_after(),
+                                      **trace_headers()})
                 else:
                     self._send(504,
                                {"error": "inference timed out after "
-                                         f"{request_timeout:g}s"})
+                                         f"{request_timeout:g}s"},
+                               extra=trace_headers())
                 return
             except Exception as e:   # engine fan-out / batcher stopped:
                 # an HTTP error beats a dropped keep-alive connection
-                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                self._send(500, {"error": f"{type(e).__name__}: {e}"},
+                           extra=trace_headers())
                 return
             # The version that COMPUTED this batch (tagged onto the
             # future by the completion thread) — under canary routing
             # that is not necessarily the live version.
             self._send(200, {"classes": logits.argmax(-1).tolist(),
                              "n": int(x.shape[0]),
-                             "version": getattr(fut, "version", None)})
+                             "version": getattr(fut, "version", None)},
+                       extra=trace_headers())
 
     srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     bound = srv.server_address[1]
@@ -748,6 +848,12 @@ def main(argv=None) -> int:
     if (args.serve_retry_after_cap_s is not None
             and args.serve_retry_after_cap_s < 1):
         p.error("--serve-retry-after-cap-s must be >= 1")
+    if (args.serve_trace_sample is not None
+            and not 0.0 <= args.serve_trace_sample <= 1.0):
+        p.error("--serve-trace-sample must be in [0, 1]")
+    if (args.serve_trace_capacity is not None
+            and args.serve_trace_capacity < 1):
+        p.error("--serve-trace-capacity must be >= 1")
     if args.serve_faults is not None:
         # a malformed chaos schedule is a usage error NOW — it must
         # never boot a server that silently injects nothing
@@ -774,6 +880,17 @@ def main(argv=None) -> int:
         log.warning("FAULT INJECTION ACTIVE (--serve-faults %r, seed "
                     "%d) — this process is a chaos target, not a "
                     "production server", cfg.serve_faults, cfg.seed)
+    if cfg.serve_trace:
+        from distributedmnist_tpu.serve import trace as trace_lib
+        trace_lib.install(trace_lib.Tracer(
+            capacity=cfg.serve_trace_capacity,
+            sample=cfg.serve_trace_sample,
+            slo_ms=cfg.serve_slo_ms, seed=cfg.seed))
+        log.info("request tracing ACTIVE (capacity %d, sample %.2f, "
+                 "slo %s ms): GET /trace exports Chrome trace-event "
+                 "JSON; /predict responses carry X-Trace-Id",
+                 cfg.serve_trace_capacity, cfg.serve_trace_sample,
+                 cfg.serve_slo_ms)
     batcher = DynamicBatcher(router, max_batch=cfg.serve_max_batch,
                              max_wait_us=cfg.serve_max_wait_us,
                              queue_depth=cfg.serve_queue_depth,

@@ -53,6 +53,14 @@ def pytest_configure(config):
         "resource-balance accounting, and the AST project lint); pure "
         "python, runs in tier-1 — `-m analysis` selects just this "
         "suite")
+    config.addinivalue_line(
+        "markers",
+        "trace: request-tracing test (serve/trace.py: span trees, "
+        "sampling/exemplar retention, Chrome export, stage "
+        "attribution, the /trace + Prometheus surfaces); cheap and "
+        "deterministic, runs in tier-1 under the serve sanitizer "
+        "fixture — `-m trace` selects just this suite "
+        "(scripts/tier1.sh notes the inclusion)")
     # A DMNIST_SANITIZE=1 environment installs a process-global
     # sanitizer at import time — under pytest that instance must yield
     # to the per-test installs (the serve autouse fixture and the

@@ -168,6 +168,79 @@ def test_dml006_recycle_in_finally_clean():
     assert _rules(src) == []
 
 
+# -- DML007: span begin without try/finally end ----------------------------
+
+
+def test_dml007_unprotected_begin_span_flagged():
+    src = ("from distributedmnist_tpu.serve import trace\n"
+           "def dispatch(self, seg):\n"
+           "    sp = trace.begin_span('batch.dispatch', rids=[1])\n"
+           "    return self.engine.dispatch(seg)\n")
+    assert _rules(src) == ["DML007"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert f.line == 3 and "end_span" in f.message
+
+
+def test_dml007_try_finally_end_is_clean():
+    src = ("from distributedmnist_tpu.serve import trace\n"
+           "def dispatch(self, seg):\n"
+           "    sp = trace.begin_span('batch.dispatch')\n"
+           "    try:\n"
+           "        return self.engine.dispatch(seg)\n"
+           "    finally:\n"
+           "        trace.end_span(sp)\n")
+    assert _rules(src) == []
+    # try/except/finally (the completion-loop shape) is protected too
+    src2 = ("from distributedmnist_tpu.serve import trace\n"
+            "def fetch(self, h):\n"
+            "    sp = trace.begin_span('engine.fetch')\n"
+            "    try:\n"
+            "        return self.engine.fetch(h)\n"
+            "    except Exception as e:\n"
+            "        trace.end_span(sp, error=type(e).__name__)\n"
+            "        raise\n"
+            "    finally:\n"
+            "        trace.end_span(sp)\n")
+    assert _rules(src2) == []
+
+
+def test_dml007_end_outside_finally_not_enough():
+    """An end_span only on the happy path is exactly the bug the rule
+    exists for — the try must END the span in a finally."""
+    src = ("from distributedmnist_tpu.serve import trace\n"
+           "def dispatch(self, seg):\n"
+           "    sp = trace.begin_span('batch.dispatch')\n"
+           "    try:\n"
+           "        out = self.engine.dispatch(seg)\n"
+           "        trace.end_span(sp)\n"
+           "        return out\n"
+           "    except Exception:\n"
+           "        raise\n")
+    assert _rules(src) == ["DML007"]
+
+
+def test_dml007_nested_statement_lists_checked():
+    """A begin at any nesting depth is checked against ITS OWN
+    statement list (the if-guarded begin must still be followed by its
+    try)."""
+    src = ("from distributedmnist_tpu.serve import trace\n"
+           "def f(self):\n"
+           "    if self.on:\n"
+           "        sp = trace.begin_span('engine.staging')\n"
+           "    work()\n")
+    assert _rules(src) == ["DML007"]
+
+
+def test_dml007_scope_is_serve_and_trace_py_exempt():
+    src = ("from distributedmnist_tpu.serve import trace\n"
+           "sp = trace.begin_span('x.y')\n")
+    # tests, bench and the trace facility itself are out of scope
+    assert _rules(src, "tests/test_serve_trace.py") == []
+    assert _rules(src, "bench.py") == []
+    assert _rules(src, "distributedmnist_tpu/serve/trace.py") == []
+    assert _rules(src, "serve.py") == ["DML007"]
+
+
 # -- allowlist pragma ------------------------------------------------------
 
 

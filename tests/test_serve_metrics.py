@@ -1,0 +1,199 @@
+"""serve/metrics.py ISSUE 9 satellites: per-version breaker-trip
+attribution (the version argument used to be silently dropped),
+percentile computation OFF the metrics lock (a /metrics poll must not
+stall the recording hooks on the dispatch hot path), and the Prometheus
+text exposition (stable names, # TYPE lines, histogram cumulation,
+label escaping, None-skipping)."""
+
+import threading
+import time
+
+import pytest
+
+from distributedmnist_tpu.serve import ServeMetrics, prometheus_exposition
+from distributedmnist_tpu.serve import metrics as metrics_mod
+
+pytestmark = pytest.mark.trace
+
+
+# -- breaker trips by version ----------------------------------------------
+
+
+def test_breaker_trips_attributed_per_version():
+    m = ServeMetrics()
+    m.record_breaker_trip("v1")
+    m.record_breaker_trip("v2")
+    m.record_breaker_trip("v1")
+    res = m.snapshot()["resilience"]
+    assert res["breaker_trips"] == 3
+    assert res["breaker_trips_by_version"] == {"v1": 2, "v2": 1}
+
+
+def test_breaker_trip_without_version_counts_total_only():
+    m = ServeMetrics()
+    m.record_breaker_trip(None)
+    res = m.snapshot()["resilience"]
+    assert res["breaker_trips"] == 1
+    assert res["breaker_trips_by_version"] == {}
+
+
+def test_breaker_trips_reset_with_window():
+    m = ServeMetrics()
+    m.record_breaker_trip("v1")
+    m.reset()
+    res = m.snapshot()["resilience"]
+    assert res["breaker_trips"] == 0
+    assert res["breaker_trips_by_version"] == {}
+
+
+# -- snapshot off the lock -------------------------------------------------
+
+
+def test_snapshot_does_not_hold_lock_through_percentiles(monkeypatch):
+    """Contention regression (ISSUE 9 satellite): snapshot() used to
+    compute percentiles over up-to-100k-sample deques WHILE holding
+    the metrics lock, stalling every recording hook whenever /metrics
+    was polled. Pin the fix: with percentile math slowed to 0.2s per
+    call, a concurrent record_latency must still land in
+    milliseconds."""
+    m = ServeMetrics()
+    for _ in range(1000):
+        m.record_latency(0.001, rows=1, version="v1")
+
+    real = metrics_mod.percentiles
+
+    def slow_percentiles(values, qs=(50, 95, 99)):
+        time.sleep(0.2)
+        return real(values, qs)
+
+    monkeypatch.setattr(metrics_mod, "percentiles", slow_percentiles)
+    in_snapshot = threading.Event()
+
+    def poll():
+        in_snapshot.set()
+        m.snapshot()
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    assert in_snapshot.wait(timeout=5)
+    time.sleep(0.05)               # the poller is now inside the math
+    t0 = time.monotonic()
+    m.record_latency(0.002)        # the hot-path hook under test
+    record_s = time.monotonic() - t0
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert record_s < 0.1, (
+        f"record_latency blocked {record_s:.3f}s behind a snapshot — "
+        "percentiles are being computed under the metrics lock again")
+
+
+def test_snapshot_shape_unchanged_after_offlock_rework():
+    """The off-lock rework must not change the snapshot contract the
+    bench/serve surfaces read."""
+    m = ServeMetrics()
+    m.record_latency(0.01, rows=4, version="v1")
+    m.record_dispatch(0.001, inflight=2)
+    m.record_fetch(0.002)
+    m.record_batch(rows=4, bucket=8, queue_depth=1, version="v1",
+                   replica="r0", infer_dtype="float32")
+    m.record_wait(0.0005)
+    snap = m.snapshot()
+    assert snap["requests"] == 1 and snap["rows"] == 4
+    assert snap["latency_ms"]["p50"] == pytest.approx(10.0, rel=1e-3)
+    assert snap["batch_occupancy"]["8"]["rows"] == 4
+    assert snap["by_version"]["v1"]["requests"] == 1
+    assert snap["by_replica"]["r0"]["batches"] == 1
+    assert snap["by_dtype"]["float32"]["rows"] == 4
+    assert snap["padding_waste_ratio"] == 0.5     # 4 real of 8 slots
+    assert snap["effective_wait_us"]["last"] == 500.0
+
+
+# -- Prometheus exposition -------------------------------------------------
+
+
+def _sample_snapshot():
+    m = ServeMetrics()
+    for i in range(10):
+        m.record_latency(0.001 * (i + 1), rows=2, version="v1")
+    m.record_batch(rows=8, bucket=8, queue_depth=2, version="v1",
+                   replica="r0", infer_dtype="int8")
+    m.record_reject(3)
+    m.record_deadline_shed(2)
+    m.record_breaker_trip("v1")
+    m.record_failover("fetch", "r0", "r1")
+    return m.snapshot()
+
+
+def test_prometheus_exposition_counters_and_types():
+    text = prometheus_exposition(_sample_snapshot())
+    lines = text.splitlines()
+    assert "# TYPE dmnist_serve_requests_total counter" in lines
+    assert "dmnist_serve_requests_total 10" in lines
+    assert "dmnist_serve_rows_total 20" in lines
+    assert "dmnist_serve_rejected_requests_total 1" in lines
+    assert "dmnist_serve_deadline_shed_requests_total 1" in lines
+    assert 'dmnist_serve_breaker_version_trips_total{version="v1"} 1' \
+        in lines
+    assert 'dmnist_serve_failovers_total{kind="fetch"} 1' in lines
+    assert 'dmnist_serve_version_requests_total{version="v1"} 10' \
+        in lines
+    assert 'dmnist_serve_replica_batches_total{replica="r0"} 1' in lines
+    assert 'dmnist_serve_dtype_batches_total{dtype="int8"} 1' in lines
+    assert 'dmnist_serve_bucket_dispatches_total{bucket="8"} 1' in lines
+    # summaries carry quantile labels, never a fabricated 0 for an
+    # empty window
+    assert "# TYPE dmnist_serve_latency_ms summary" in lines
+    assert any(l.startswith('dmnist_serve_latency_ms{quantile="0.5"}')
+               for l in lines)
+    assert "dmnist_serve_latency_ms_count 10" in lines
+    # every # TYPE line names a metric that actually has samples
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE"):
+            name = line.split()[2]
+            assert any(l.startswith(name) for l in lines[i + 1:]), name
+
+
+def test_prometheus_empty_window_skips_none_summaries():
+    text = prometheus_exposition(ServeMetrics().snapshot())
+    assert "quantile" not in text          # no latency samples -> no
+    assert "NaN" not in text               # summary, no fake zeros
+    assert "None" not in text
+    assert "dmnist_serve_requests_total 0" in text
+
+
+def test_prometheus_gauges_and_label_escaping():
+    text = prometheus_exposition(_sample_snapshot(),
+                                 gauges={"pending_rows": 7})
+    assert "# TYPE dmnist_serve_pending_rows gauge" in text
+    assert "dmnist_serve_pending_rows 7" in text
+    m = ServeMetrics()
+    m.record_breaker_trip('v"weird\\name')
+    text = prometheus_exposition(m.snapshot())
+    assert r'version="v\"weird\\name"' in text
+
+
+def test_prometheus_stage_histogram_cumulates():
+    """Span-derived stage histograms flatten with CUMULATIVE buckets
+    (the Prometheus histogram contract), one series per stage."""
+    from distributedmnist_tpu.serve import trace as trace_lib
+
+    tr = trace_lib.Tracer()
+    tr.add_span("queue.wait", 0.0, 0.0003, rids=())      # 0.3 ms
+    tr.add_span("queue.wait", 0.0, 0.002, rids=())       # 2 ms
+    tr.add_span("queue.wait", 0.0, 5.0, rids=())         # 5000 ms: +Inf
+    stages = tr.snapshot()["stages"]
+    text = prometheus_exposition(ServeMetrics().snapshot(),
+                                 trace_stages=stages)
+    lines = text.splitlines()
+    assert "# TYPE dmnist_serve_stage_duration_ms histogram" in lines
+    get = lambda le: next(  # noqa: E731
+        float(l.split()[-1]) for l in lines
+        if l.startswith("dmnist_serve_stage_duration_ms_bucket")
+        and f'le="{le}"' in l and 'stage="queue.wait"' in l)
+    assert get("0.25") == 0
+    assert get("0.5") == 1
+    assert get("2.5") == 2
+    assert get("1000") == 2
+    assert get("+Inf") == 3
+    assert ('dmnist_serve_stage_duration_ms_count{stage="queue.wait"} 3'
+            in lines)
