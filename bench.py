@@ -190,6 +190,24 @@ def main(argv=None) -> int:
                         "fast path behind the parity gate; auto = "
                         "cheapest parity-passing variant (default "
                         "float32)")
+    p.add_argument("--zipf", action="store_true", default=None,
+                   help="[serve] add the hot-key leg (ISSUE 10): a "
+                        "seeded Zipf-distributed request mix driven "
+                        "closed-loop with the prediction cache + "
+                        "single-flight front OFF then ON — hit ratio, "
+                        "goodput ratio, p99 and device-dispatch "
+                        "counts in one record, cached responses "
+                        "parity-checked byte-identical against "
+                        "computed ones")
+    p.add_argument("--zipf-cache-off", action="store_true", default=None,
+                   help="[serve] run the --zipf leg WITHOUT the cache-"
+                        "on phase (a cache-off control record); "
+                        "--baseline refuses deltas between cache-on "
+                        "and cache-off zipf records the same way it "
+                        "refuses cross-dtype ones")
+    p.add_argument("--serve-cache-capacity", type=int, default=None,
+                   help="[serve] prediction-cache capacity in entries "
+                        "for the --zipf leg (default 4096)")
     p.add_argument("--dtype-sweep", action="store_true", default=None,
                    help="[serve] add the inference fast-path leg: warm "
                         "+ parity-gate bf16 and int8 variants, then "
@@ -259,6 +277,9 @@ def main(argv=None) -> int:
                    "--serve-replicas": args.serve_replicas,
                    "--serve-hedge": args.serve_hedge,
                    "--serve-infer-dtype": args.serve_infer_dtype,
+                   "--zipf": args.zipf,
+                   "--zipf-cache-off": args.zipf_cache_off,
+                   "--serve-cache-capacity": args.serve_cache_capacity,
                    "--dtype-sweep": args.dtype_sweep,
                    "--baseline": args.baseline,
                    "--chaos": args.chaos,
@@ -309,6 +330,12 @@ def main(argv=None) -> int:
                 p.error("--serve-qps targets must be positive")
         if args.serve_slo_ms is not None and args.serve_slo_ms <= 0:
             p.error("--serve-slo-ms must be > 0")
+        if (args.serve_cache_capacity is not None
+                and args.serve_cache_capacity < 1):
+            p.error("--serve-cache-capacity must be >= 1")
+        if args.zipf_cache_off and not args.zipf:
+            p.error("--zipf-cache-off modifies the --zipf leg; pass "
+                    "--zipf too")
         if args.serve_replicas is not None and args.serve_replicas < 1:
             p.error("--serve-replicas must be >= 1")
         if args.chaos:
@@ -1219,6 +1246,137 @@ def _serve_dtype_sweep(registry, router, factory, metrics, make_batcher,
     return leg
 
 
+def _serve_zipf_leg(router, metrics, factory, make_batcher,
+                    pipelined: int, clients: int, duration: float,
+                    cache_on: bool = True,
+                    cache_capacity: int = 4096) -> dict:
+    """The hot-key proof leg (ISSUE 10 acceptance): a seeded
+    Zipf-distributed request mix — what real million-user traffic looks
+    like — driven closed-loop twice over the SAME request sequence:
+    first with the prediction-cache front OFF (every repeat pays full
+    queue + staging + device cost), then ON (bounded LRU + single-
+    flight collapse + intra-batch dedup). The record carries hit ratio,
+    goodput ratio, p99 and the device-dispatch counts side by side, so
+    the cache's win is a measured ratio on one host, not a claim.
+
+    Parity is checked IN the leg: for fresh probe keys, the computed
+    (miss) response and the subsequent cached (hit) response must be
+    byte-identical — the cache may only ever return exactly the bytes
+    the pipeline produced. `cache_on=False` (--zipf-cache-off) runs
+    only the control phase; the resulting record is marked
+    `cache_enabled: false` and --baseline refuses deltas across that
+    boundary exactly like cross-dtype ones."""
+    import numpy as np
+
+    from distributedmnist_tpu.serve.cache import (CacheFront,
+                                                  PredictionCache)
+
+    n_keys = 64
+    zipf_s = 1.1
+    rng = np.random.default_rng(29)
+    max_rows = min(4, factory.max_batch)
+    keys = [rng.integers(0, 256, (int(sz), 28, 28, 1), dtype=np.uint8)
+            for sz in rng.integers(1, max_rows + 1, n_keys)]
+    weights = 1.0 / np.arange(1, n_keys + 1) ** zipf_s
+    weights /= weights.sum()
+    order = rng.choice(n_keys, size=2048, p=weights)
+    reqs = [keys[i] for i in order]
+
+    def keep(snap: dict) -> dict:
+        return {"rows_per_sec": snap["rows_per_sec"],
+                "requests_per_sec": snap["requests_per_sec"],
+                "latency_ms": snap["latency_ms"],
+                "batches": snap["batches"],
+                "dispatched_rows": snap["dispatched_rows"],
+                "rejected_requests": snap["rejected_requests"]}
+
+    b = make_batcher(pipelined, adaptive=False)
+    try:
+        _mark(f"zipf closed loop [cache off]: {clients} clients x "
+              f"{duration:.0f}s, {n_keys} keys, s={zipf_s}")
+        off_snap = _serve_closed_loop(b, metrics, reqs, clients,
+                                      duration)
+    finally:
+        b.stop()
+    off = keep(off_snap)
+
+    leg = {
+        "distinct_keys": n_keys,
+        "zipf_s": zipf_s,
+        "seed": 29,
+        "max_rows_per_request": max_rows,
+        "clients": clients,
+        "duration_s": duration,
+        "cache_enabled": cache_on,
+        "cache_capacity": cache_capacity if cache_on else None,
+        "cache_off": off,
+        "cache_on": None,
+    }
+    if not cache_on:
+        _mark(f"zipf [cache off only]: {off['rows_per_sec']:.0f} "
+              f"rows/s, p99 {off['latency_ms']['p99']} ms, "
+              f"{off['batches']} device dispatches")
+        return leg
+
+    cache = PredictionCache(cache_capacity)
+    b2 = make_batcher(pipelined, adaptive=False, dedup=True)
+    front = CacheFront(b2, router, cache, metrics=metrics)
+    parity_probes = 0
+    parity_ok = True
+    try:
+        _mark(f"zipf closed loop [cache on]: {clients} clients x "
+              f"{duration:.0f}s (capacity {cache_capacity})")
+        on_snap = _serve_closed_loop(front, metrics, reqs, clients,
+                                     duration)
+        # Byte-identity parity: a FRESH probe's first submit computes
+        # (miss -> pipeline), its second is served from the cache; the
+        # two responses must be the same bytes, always.
+        probe_rng = np.random.default_rng(31)
+        for _ in range(8):
+            probe = probe_rng.integers(0, 256, (2, 28, 28, 1),
+                                       dtype=np.uint8)
+            computed = front.submit(probe).result(timeout=60)
+            cached = front.submit(probe).result(timeout=60)
+            parity_probes += 1
+            if computed.tobytes() != cached.tobytes():
+                parity_ok = False
+        _drain_or_die(front, timeout=60)
+    finally:
+        b2.stop()
+    on = keep(on_snap)
+    stats = cache.stats()
+    dedup = on_snap.get("dedup", {})
+    hit_ratio = stats["hit_ratio"]
+    goodput_x = (round(on["rows_per_sec"] / off["rows_per_sec"], 3)
+                 if off["rows_per_sec"] else None)
+    leg.update({
+        "cache_on": {**on, "cache": stats, "dedup": dedup},
+        # ISSUE 10 acceptance: hit ratio >= 0.5 on the Zipf mix,
+        # goodput >= 2x the cache-off leg, device dispatches strictly
+        # lower, cached bytes identical to computed ones
+        "hit_ratio": hit_ratio,
+        "hit_ratio_ok": hit_ratio is not None and hit_ratio >= 0.5,
+        "goodput_x": goodput_x,
+        "goodput_ok": goodput_x is not None and goodput_x >= 2.0,
+        "p99_off_ms": off["latency_ms"]["p99"],
+        "p99_on_ms": on["latency_ms"]["p99"],
+        "device_dispatches_off": off["batches"],
+        "device_dispatches_on": on["batches"],
+        "device_dispatch_lower": on["batches"] < off["batches"],
+        "single_flight_collapsed": stats["collapsed"],
+        "parity_probes": parity_probes,
+        "parity_ok": parity_ok,
+    })
+    _mark(f"zipf: hit ratio {hit_ratio} (bar >= 0.5), goodput "
+          f"{off['rows_per_sec']:.0f} -> {on['rows_per_sec']:.0f} "
+          f"rows/s ({goodput_x}x, bar >= 2x), device dispatches "
+          f"{off['batches']} -> {on['batches']}, p99 "
+          f"{off['latency_ms']['p99']} -> {on['latency_ms']['p99']} "
+          f"ms, {stats['collapsed']} collapsed, parity "
+          f"{'ok' if parity_ok else 'FAILED'} ({parity_probes} probes)")
+    return leg
+
+
 def _trace_attribution_rows(traces: list) -> list:
     """Per-request stage-attribution table rows for EVERY given trace
     (slowest first): total wall clock, per-stage blame, and the
@@ -1647,6 +1805,18 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
         "dtype_sweep_best_speedup": (
             (cur_d.get("dtype_sweep") or {}).get("best_speedup"),
             (base_d.get("dtype_sweep") or {}).get("best_speedup")),
+        # the hot-key cache signals (ISSUE 10): None-vs-None without
+        # --zipf; cache-on-vs-cache-off mixes were REFUSED before any
+        # load phase, so these rows always compare like with like
+        "zipf_hit_ratio": (
+            (cur_d.get("zipf") or {}).get("hit_ratio"),
+            (base_d.get("zipf") or {}).get("hit_ratio")),
+        "zipf_goodput_x": (
+            (cur_d.get("zipf") or {}).get("goodput_x"),
+            (base_d.get("zipf") or {}).get("goodput_x")),
+        "zipf_p99_on_ms": (
+            (cur_d.get("zipf") or {}).get("p99_on_ms"),
+            (base_d.get("zipf") or {}).get("p99_on_ms")),
     }
     delta = {"path": path,
              "baseline_value": baseline.get("value"),
@@ -1890,6 +2060,23 @@ def _serve(args) -> int:
                   "meaningless (ROADMAP: CPU records must not "
                   "masquerade as TPU headlines)")
             return 4
+        # Cache-on-vs-cache-off zipf records are as incomparable as
+        # cross-dtype ones (ISSUE 10): a hot-key goodput number with
+        # the cache on must never print a delta against a cache-off
+        # control round (or vice versa).
+        base_zipf = baseline_rec["detail"].get("zipf")
+        if args.zipf and isinstance(base_zipf, dict):
+            cur_cache_on = not args.zipf_cache_off
+            base_cache_on = bool(base_zipf.get("cache_enabled"))
+            if cur_cache_on != base_cache_on:
+                _mark(f"REFUSING --baseline {args.baseline}: its zipf "
+                      f"leg ran cache_enabled={base_cache_on}, this "
+                      f"run is cache_enabled={cur_cache_on} — "
+                      "cache-on-vs-cache-off serve deltas are "
+                      "meaningless (an uncached control must not "
+                      "masquerade as a cache regression, nor a cached "
+                      "round as a pipeline win)")
+                return 4
 
     _mark(f"warming {len(factory.buckets)} buckets "
           f"{list(factory.buckets)}")
@@ -1933,7 +2120,8 @@ def _serve(args) -> int:
 
     def make_batcher(max_inflight: int, split: bool = True,
                      adaptive: bool = None, wait_us: int = None,
-                     resilience=None) -> DynamicBatcher:
+                     resilience=None,
+                     dedup: bool = False) -> DynamicBatcher:
         if adaptive is None:
             adaptive = not args.no_adaptive
         return DynamicBatcher(router, max_batch=factory.max_batch,
@@ -1946,6 +2134,7 @@ def _serve(args) -> int:
                               resilience=(default_resilience
                                           if resilience is None
                                           else resilience),
+                              dedup=dedup,
                               metrics=metrics).start()
 
     # Phase 1 — serial baseline: inflight=1 is the pre-pipeline chain
@@ -2006,7 +2195,19 @@ def _serve(args) -> int:
                                pipelined, clients, duration, low_qps,
                                max_wait_us)
 
-    # Phase 3b (optional) — the request-tracing leg (ISSUE 9): a
+    # Phase 3b (optional) — the hot-key leg (ISSUE 10): the SAME
+    # Zipf-distributed request mix closed-loop with the prediction
+    # cache + single-flight front off then on, on its own batchers —
+    # the headline phases above stay cache-less, so the capacity
+    # number keeps pricing the raw pipeline.
+    zipf_leg = None
+    if args.zipf:
+        zipf_leg = _serve_zipf_leg(
+            router, metrics, factory, make_batcher, pipelined, clients,
+            duration, cache_on=not args.zipf_cache_off,
+            cache_capacity=args.serve_cache_capacity or 4096)
+
+    # Phase 3c (optional) — the request-tracing leg (ISSUE 9): a
     # mixed-size open-loop window under an installed tracer, per-
     # request stage attribution for the over-SLO tail, and the Chrome
     # trace artifact. Runs on its own batcher with its own tracer —
@@ -2199,6 +2400,14 @@ def _serve(args) -> int:
             "closed_loop": closed,
             "qps_sweep": table,
             "ragged": ragged,
+            # The hot-key leg (ISSUE 10; None without --zipf): hit
+            # ratio, goodput ratio, p99 and device-dispatch counts for
+            # the same Zipf mix with the prediction cache off vs on,
+            # plus the byte-identity parity probes and the
+            # single-flight collapse count. cache_enabled marks
+            # control (--zipf-cache-off) records — --baseline refuses
+            # deltas across that boundary.
+            "zipf": zipf_leg,
             "swap": swap,
             "chaos": chaos,
             # The tracing leg (ISSUE 9; None without --trace): the SLO

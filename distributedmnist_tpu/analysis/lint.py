@@ -70,6 +70,15 @@ RULES = {
         "calls end_span(). Spans that end on another thread are "
         "synthesized closed via add_span from monotonic stamps instead "
         "— begin_span is strictly same-thread"),
+    "DML008": (
+        "cache state mutated outside the cache's named lock",
+        "the prediction cache's LRU table and single-flight flight "
+        "registry (_entries/_flights — ISSUE 10) are mutated from "
+        "submit threads, the completion thread's done-callbacks AND "
+        "the registry's invalidation hook; any mutation outside a "
+        "`with <...>_lock:` block is a torn-LRU / double-resolved-"
+        "follower race the sanitizer can only catch if it happens to "
+        "fire — the lint rejects the shape outright"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -81,6 +90,15 @@ _SPEC_SHAPED_RE = re.compile(r"^;?[a-z_]+\.[a-z_]+:[^;]*=")
 
 _BARE_PRIMITIVES = frozenset(
     ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"))
+
+# DML008: the prediction cache's mutable state containers (ISSUE 10)
+# and the attribute-call names that mutate a dict/OrderedDict. Reads
+# (.get, .items, len) are free; anything here must sit under the
+# cache's named lock.
+_CACHE_STATE_ATTRS = frozenset(("_entries", "_flights"))
+_MUTATING_METHODS = frozenset(
+    ("pop", "popitem", "clear", "setdefault", "update", "move_to_end",
+     "append"))
 
 
 @dataclasses.dataclass
@@ -204,6 +222,30 @@ def lint_source(text: str, rel: str) -> list:
                 for sub in ast.walk(stmt):
                     in_finally.add(id(sub))
 
+    # lock-containment index for DML008: every node id located inside a
+    # `with <...>_lock:` block (any expression whose trailing name ends
+    # in `_lock` counts — `self._lock`, `cache._lock`, a bare `_lock`).
+    def _is_lock_expr(e: ast.AST) -> bool:
+        if isinstance(e, ast.Attribute):
+            return e.attr.endswith("_lock")
+        if isinstance(e, ast.Name):
+            return e.id.endswith("_lock")
+        return False
+
+    under_lock: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With) and any(
+                _is_lock_expr(item.context_expr) for item in node.items):
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    under_lock.add(id(sub))
+
+    def _cache_state_attr(expr: ast.AST) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and expr.attr in _CACHE_STATE_ATTRS):
+            return expr.attr
+        return None
+
     for node in ast.walk(tree):
         # DML001 / DML002: bare threading constructors.
         if isinstance(node, ast.Call):
@@ -285,6 +327,45 @@ def lint_source(text: str, rel: str) -> list:
                         "staging-pool recycle outside a finally block — "
                         "an error path here leaks one pooled buffer per "
                         "failure (the PR 5 fetch-storm leak)"))
+        # DML008: cache-state mutation outside the cache's named lock
+        # (ISSUE 10). Three mutation shapes: a mutating method call on
+        # _entries/_flights, a subscript store into one, a subscript
+        # delete from one. Reads (.get/.items/len) and whole-attribute
+        # rebinding in a constructor are free.
+        if _in_serve_pkg(rel):
+            hit = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATING_METHODS
+                        and _cache_state_attr(f.value)):
+                    hit = (node, f"{_cache_state_attr(f.value)}"
+                                 f".{f.attr}()")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Subscript)
+                            and _cache_state_attr(t.value)):
+                        hit = (node, f"{_cache_state_attr(t.value)}"
+                                     "[...] = ...")
+                        break
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and _cache_state_attr(t.value)):
+                        hit = (node, "del "
+                                     f"{_cache_state_attr(t.value)}"
+                                     "[...]")
+                        break
+            if hit is not None and id(hit[0]) not in under_lock:
+                findings.append(Finding(
+                    rel, hit[0].lineno, "DML008",
+                    f"cache state mutation {hit[1]} outside a "
+                    "`with <cache>._lock:` block — concurrent "
+                    "lookups, the single-flight done-callback and the "
+                    "registry's invalidation hook race this state "
+                    "(torn LRU / double-resolved follower)"))
         # DML003 (literal form): spec-shaped string constants anywhere
         # outside docstrings — catches the bench's concatenated /
         # f-string chaos schedules piece by piece.

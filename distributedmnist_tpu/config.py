@@ -185,6 +185,23 @@ class Config:
     # "auto" warms+gates every variant and serves the cheapest
     # parity-passing one by the warmup-measured bucket cost tables.
     serve_infer_dtype: str = "float32"
+    # Prediction cache + request dedup front layer (ISSUE 10,
+    # serve/cache.py): serve_cache puts a bounded LRU response cache
+    # keyed by (live version, infer_dtype, content hash of the input
+    # bytes) in front of the batcher — repeats of a hot key are served
+    # sub-millisecond with zero device work, concurrent identical
+    # misses collapse onto ONE in-flight computation (single-flight),
+    # and the registry invalidates atomically on promote/rollback/
+    # dtype activation so a stale-version hit is impossible.
+    # serve_cache_capacity bounds resident entries (LRU eviction past
+    # it). serve_dedup additionally collapses identical rows INSIDE one
+    # coalesced batcher drain (dispatch once, fan out — shrinks padded
+    # buckets). Both default off: caching is a per-deployment choice
+    # (it changes which requests ever reach the fault-injection
+    # failpoints), and the Zipf bench leg measures the win explicitly.
+    serve_cache: bool = False
+    serve_cache_capacity: int = 4096
+    serve_dedup: bool = False
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -350,6 +367,23 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "prove-it pass AND the accuracy-parity gate); "
                         "auto = cheapest parity-passing variant by the "
                         "warmup cost tables")
+    p.add_argument("--serve-cache", dest="serve_cache",
+                   action="store_true", default=None,
+                   help="[serving] enable the prediction cache +"
+                        " single-flight front layer (serve/cache.py):"
+                        " content-hash repeats served without device"
+                        " work, concurrent identical misses collapsed"
+                        " onto one computation, invalidated atomically"
+                        " on promote/rollback/dtype activation")
+    p.add_argument("--serve-cache-capacity", type=int, default=None,
+                   help="[serving] bounded prediction-cache size in "
+                        "entries (LRU eviction past it; default 4096)")
+    p.add_argument("--serve-dedup", dest="serve_dedup",
+                   action="store_true", default=None,
+                   help="[serving] collapse identical rows inside one "
+                        "coalesced batcher drain into a single "
+                        "dispatch (intra-batch dedup — shrinks padded "
+                        "buckets on hot-key traffic)")
     p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "

@@ -33,6 +33,11 @@ north star's "serves heavy traffic from millions of users".
               sampling with error/over-SLO exemplars, Chrome
               trace-event export, stage attribution, and the
               per-stage histograms behind /metrics' Prometheus surface
+- cache.py    prediction cache + single-flight dedup front layer
+              (ISSUE 10): bounded LRU keyed by (live version,
+              infer_dtype, content hash), concurrent identical misses
+              collapsed onto one in-flight computation, registry-
+              invalidated atomically on every live-route change
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -102,6 +107,12 @@ _EXPORTS = {
                          "attribute_stages"),
     "prometheus_exposition": ("distributedmnist_tpu.serve.metrics",
                               "prometheus_exposition"),
+    "PredictionCache": ("distributedmnist_tpu.serve.cache",
+                        "PredictionCache"),
+    "CacheFront": ("distributedmnist_tpu.serve.cache", "CacheFront"),
+    "content_key": ("distributedmnist_tpu.serve.cache", "content_key"),
+    "build_cache_front": ("distributedmnist_tpu.serve.cache",
+                          "build_cache_front"),
 }
 
 __all__ = list(_EXPORTS)

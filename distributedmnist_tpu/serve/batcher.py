@@ -55,6 +55,15 @@ live version back. The dispatch site is a named failpoint
 (`batch.dispatch`, ctx=request ids) so serve/faults.py can inject
 deterministic poison for tests and `bench.py serve --chaos`.
 
+Dedup (ISSUE 10, serve/cache.py): with `dedup=True`, identical rows
+inside one coalesced drain (same content hash — the faults.py idiom)
+dispatch ONCE: riders attach to their representative request and fan
+out from its result slice, so five identical 4-row requests run the
+4-row bucket instead of padding a 32. The cross-drain sibling — a
+bounded LRU response cache with single-flight collapse of concurrent
+identical misses — is the CacheFront layer in serve/cache.py, which
+sits in FRONT of this batcher.
+
 Tracing (ISSUE 9, serve/trace.py): with a tracer installed, every
 request's path through this pipeline is recorded as a span tree —
 queue wait, the coalesce window, the batch former's plan, dispatch,
@@ -68,6 +77,7 @@ module-global None check.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import queue
 import threading
@@ -116,6 +126,15 @@ class _Request:
     #   fault injector's request-sticky draws and bisection key on
     deadline: Optional[float] = None   # monotonic; None = no deadline
     future: Future = field(default_factory=Future)
+    # Content hash (ISSUE 10): sha256 of the canonical input bytes,
+    # computed at submit when dedup is on (or handed down by the
+    # CacheFront, which already hashed for its lookup). None = dedup
+    # off for this request.
+    key: Optional[bytes] = None
+    # Intra-batch duplicates riding this request (ISSUE 10): identical
+    # rows popped in the same drain dispatch ONCE — this request — and
+    # fan the shared slice out to every rider's future at resolution.
+    dups: list = field(default_factory=list)
 
 
 class DynamicBatcher:
@@ -134,8 +153,17 @@ class DynamicBatcher:
                  queue_depth: int = 4096, metrics=None,
                  max_inflight: Optional[int] = None,
                  slo_ms: Optional[float] = None, adaptive: bool = True,
-                 split: bool = True, resilience=None):
+                 split: bool = True, resilience=None,
+                 dedup: bool = False):
         self.engine = engine
+        # Intra-batch dedup (ISSUE 10): identical rows inside one
+        # coalesced drain dispatch once and fan out, shrinking the
+        # padded bucket. Off by default — the chaos harness's exact
+        # poison-isolation accounting assumes one dispatch row per
+        # request, and the cache front's single-flight already
+        # collapses cross-drain repeats; serve.py wires it via
+        # cfg.serve_dedup.
+        self.dedup = dedup
         # ISSUE 5 policy bundle (serve/resilience.py): gates the failed-
         # dispatch bisection path and receives every fan-out outcome for
         # the per-version circuit breaker. None = PR 4 behavior (whole
@@ -199,7 +227,15 @@ class DynamicBatcher:
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, x, deadline_s: Optional[float] = None) -> Future:
+    def next_rid(self) -> int:
+        """A fresh request id from the batcher's sequence — the cache
+        front (serve/cache.py) stamps hit/collapsed requests from the
+        SAME id space so trace ids never collide across the two entry
+        points."""
+        return next(self._rid)
+
+    def submit(self, x, deadline_s: Optional[float] = None,
+               key: Optional[bytes] = None) -> Future:
         """Enqueue up to max_batch rows; Future resolves to their logits.
         Raises Rejected past the queue watermark (overload shedding),
         ValueError for requests no single dispatch could ever carry,
@@ -222,8 +258,14 @@ class DynamicBatcher:
             raise DeadlineExceeded(
                 "deadline already expired at submit "
                 f"({(now - deadline_s) * 1e3:.1f} ms ago)")
+        if self.dedup and key is None:
+            # the faults.py content-hash idiom over the canonical input
+            # bytes (~1 us for a 784-byte row; the CacheFront passes
+            # its already-computed digest down so the bytes hash once)
+            key = hashlib.sha256(x.tobytes()).digest()
         req = _Request(x=x, n=n, t_enqueue=now, rid=next(self._rid),
-                       deadline=deadline_s)
+                       deadline=deadline_s,
+                       key=key if self.dedup else None)
         tr = trace.active()
         if tr is not None:
             # Trace opened BEFORE the queue insert so the dispatch
@@ -420,14 +462,16 @@ class DynamicBatcher:
         if not batch:
             return None, shed     # whole drain shed: coalesce again
         t_plan = time.monotonic()
+        all_rids = [r.rid for r in batch]
+        if self.dedup:
+            batch = self._dedup_batch(batch, t_plan)
         segments = self._plan(batch)
         tr = trace.active()
         if tr is not None:
-            rids = [r.rid for r in batch]
-            tr.add_span("batch.coalesce", t_coalesce, now, rids=rids,
-                        rows=taken)
+            tr.add_span("batch.coalesce", t_coalesce, now,
+                        rids=all_rids, rows=taken)
             tr.add_span("batch.plan", t_plan, time.monotonic(),
-                        rids=rids, segments=len(segments))
+                        rids=all_rids, segments=len(segments))
         with self._inflight_lock:
             self._inflight += len(segments)
         return segments, shed
@@ -453,6 +497,53 @@ class DynamicBatcher:
             off += c
         return segments
 
+    def _dedup_batch(self, batch: list[_Request],
+                     now: float) -> list[_Request]:
+        """Intra-batch dedup (ISSUE 10): collapse requests with the
+        same content hash (and row count — implied by the hash, checked
+        anyway) into one representative per drain. Riders are attached
+        to their representative's `dups` list and resolved from its
+        slice at fan-out, so the dispatched segment carries only unique
+        rows — a drain of five identical 4-row requests runs the 4-row
+        bucket, not the 32. Rider rids never reach the dispatch
+        failpoint (they are not dispatched), so request-sticky fault
+        draws and bisection operate on unique rows only."""
+        uniques: dict = {}
+        out: list[_Request] = []
+        dup_rids: list[int] = []
+        dup_rows = 0
+        for r in batch:
+            rep = (uniques.get((r.key, r.n))
+                   if r.key is not None else None)
+            if rep is not None:
+                rep.dups.append(r)
+                dup_rids.append(r.rid)
+                dup_rows += r.n
+                continue
+            if r.key is not None:
+                uniques[(r.key, r.n)] = r
+            out.append(r)
+        if dup_rids:
+            if self.metrics is not None:
+                self.metrics.record_dedup(len(dup_rids), dup_rows)
+            trace.add_span("batch.dedup", now, now, rids=dup_rids,
+                           collapsed=len(dup_rids))
+        return out
+
+    @staticmethod
+    def _span_rids(seg: list[_Request]) -> list[int]:
+        """Request ids a batch-level trace span covers: the dispatched
+        uniques PLUS their dedup riders, so a rider's trace still shows
+        the staging/device/fetch stages that produced its bytes. The
+        dispatch FAILPOINT keeps unique rids only (riders are never
+        dispatched — a sticky fault draw on one would be undispatchable
+        and unisolatable)."""
+        rids: list[int] = []
+        for r in seg:
+            rids.append(r.rid)
+            rids.extend(d.rid for d in r.dups)
+        return rids
+
     def _live_version(self) -> Optional[str]:
         """The version a dispatch failure is blamed on: the engine's
         live target (Router) or its own version label (bare engine);
@@ -471,6 +562,17 @@ class DynamicBatcher:
         if tr is not None:
             tr.finish_request(req.rid, error=error)
 
+    def _fail_fanout(self, req: _Request, e: Exception) -> None:
+        """Fail one request AND its dedup riders with the same error —
+        a rider's bytes were going to come from this request's slice,
+        so its outcome is this request's outcome. Traces finish before
+        futures resolve, as everywhere."""
+        self._finish_trace(req, error=e)
+        req.future.set_exception(e)
+        for d in req.dups:
+            self._finish_trace(d, error=e)
+            d.future.set_exception(e)
+
     def _engine_dispatch(self, seg: list[_Request]):
         """The one engine.dispatch call site, crossed by every first
         dispatch AND every bisection retry: the `batch.dispatch`
@@ -478,9 +580,12 @@ class DynamicBatcher:
         request-sticky injected fault (serve/faults.py) fails every
         dispatch containing the poison request — and only those."""
         rids = [r.rid for r in seg]
-        sp = trace.begin_span("batch.dispatch", rids=rids,
+        sp = trace.begin_span("batch.dispatch", rids=self._span_rids(seg),
                               rows=sum(r.n for r in seg))
         try:
+            # failpoint ctx carries the DISPATCHED rids only: dedup
+            # riders are not in this dispatch, so a request-sticky
+            # draw cannot poison rows that never reach the engine
             failpoint("batch.dispatch", rids=rids)
             return self.engine.dispatch([r.x for r in seg])
         finally:
@@ -575,18 +680,21 @@ class DynamicBatcher:
         systemic = getattr(e, "status", None) == 503
         bisect = (res is not None and res.bisect and len(seg) > 1
                   and not systemic)
+        ndups = sum(len(r.dups) for r in seg)
         if not bisect:
             if self.metrics is not None:
                 if (not systemic and res is not None and res.bisect
                         and len(seg) == 1):
                     # a singleton failing at dispatch IS an isolated
-                    # culprit (no cohort to protect)
+                    # culprit (no cohort to protect); its dedup riders
+                    # fail alongside it as plain dispatch errors
                     self.metrics.record_poison_isolated(seg[0].n)
+                    if ndups:
+                        self.metrics.record_dispatch_error(ndups)
                 else:
-                    self.metrics.record_dispatch_error(len(seg))
+                    self.metrics.record_dispatch_error(len(seg) + ndups)
             for r in seg:
-                self._finish_trace(r, error=e)
-                r.future.set_exception(e)
+                self._fail_fanout(r, e)
             if res is not None and not systemic:
                 res.record_outcome(self._live_version(), ok=False,
                                    n=len(seg))
@@ -607,7 +715,7 @@ class DynamicBatcher:
             sub = pending.popleft()
             sub_err = None
             sp = trace.begin_span("bisect.dispatch",
-                                  rids=[r.rid for r in sub],
+                                  rids=self._span_rids(sub),
                                   rows=sum(r.n for r in sub))
             try:
                 handle = self._engine_dispatch(sub)
@@ -622,8 +730,10 @@ class DynamicBatcher:
                 if len(sub) == 1:
                     if self.metrics is not None:
                         self.metrics.record_poison_isolated(sub[0].n)
-                    self._finish_trace(sub[0], error=sub_err)
-                    sub[0].future.set_exception(sub_err)
+                        if sub[0].dups:
+                            self.metrics.record_dispatch_error(
+                                len(sub[0].dups))
+                    self._fail_fanout(sub[0], sub_err)
                     if res is not None:
                         res.record_outcome(self._live_version(),
                                            ok=False)
@@ -663,7 +773,7 @@ class DynamicBatcher:
                 return
             batch, handle, t_disp = item
             t0 = time.monotonic()
-            rids = [r.rid for r in batch]
+            rids = self._span_rids(batch)
             # The in-flight window this batch just spent dispatched-
             # but-unfetched: device compute overlapping later batches'
             # staging (ISSUE 2). Synthesized from stamps both threads
@@ -681,10 +791,10 @@ class DynamicBatcher:
                 # no-op (end_span is idempotent)
                 trace.end_span(sp, error=type(e).__name__)
                 for r in batch:
-                    self._finish_trace(r, error=e)
-                    r.future.set_exception(e)
+                    self._fail_fanout(r, e)
                 if self.metrics is not None:
-                    self.metrics.record_fetch_error(len(batch))
+                    self.metrics.record_fetch_error(
+                        sum(1 + len(r.dups) for r in batch))
                 if self.resilience is not None:
                     # a fetch failure is attributable: the handle knows
                     # which version computed (and failed) the batch —
@@ -707,9 +817,13 @@ class DynamicBatcher:
             if self.controller is not None:
                 # Feed the AIMD controller every request's end-to-end
                 # latency — violations step the effective wait down
-                # before this batch's futures even resolve.
+                # before this batch's futures even resolve. Dedup
+                # riders count too: their latency is as real as their
+                # representative's.
                 for r in batch:
                     self.controller.on_latency(t_done - r.t_enqueue)
+                    for d in r.dups:
+                        self.controller.on_latency(t_done - d.t_enqueue)
             off = 0
             for r in batch:
                 # Attribution rides the future itself (set BEFORE
@@ -727,6 +841,19 @@ class DynamicBatcher:
                                rids=(r.rid,))
                 self._finish_trace(r)
                 r.future.set_result(logits[off:off + r.n])
+                # Dedup riders (ISSUE 10): identical rows that rode
+                # this request instead of dispatching — same version
+                # tag and fate, but their OWN copy of the slice: the
+                # representative and its riders alias the same rows,
+                # so sharing the view would let one caller's in-place
+                # edit corrupt another's response (per-request slices
+                # of a normal batch are disjoint; these are not).
+                for d in r.dups:
+                    d.future.version = version
+                    trace.add_span("batch.fanout", t_done,
+                                   time.monotonic(), rids=(d.rid,))
+                    self._finish_trace(d)
+                    d.future.set_result(logits[off:off + r.n].copy())
                 off += r.n
             if self.metrics is not None:
                 rows = sum(r.n for r in batch)
@@ -745,6 +872,10 @@ class DynamicBatcher:
                 for r in batch:
                     self.metrics.record_latency(t_done - r.t_enqueue,
                                                 rows=r.n, version=version)
+                    for d in r.dups:
+                        self.metrics.record_latency(
+                            t_done - d.t_enqueue, rows=d.n,
+                            version=version)
             # A batch leaves the in-flight count (and frees its window
             # slot) only AFTER its futures resolved and its metrics
             # landed: inflight_batches()==0 with an empty queue then

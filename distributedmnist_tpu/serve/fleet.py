@@ -254,6 +254,12 @@ class ReplicaSet:
         # pick lock); replica 0 speaks for all
         return self.replicas[0].router.live_infer_dtype()
 
+    def live_route(self) -> tuple:
+        """(live version, infer_dtype) atomically — the prediction
+        cache's key basis (ISSUE 10); replica 0 speaks for all, same
+        as routes()."""
+        return self.replicas[0].router.live_route()
+
     def routes(self) -> dict:
         # identical across replicas by construction (every mutation
         # fans out under the fleet lock); replica 0 speaks for all

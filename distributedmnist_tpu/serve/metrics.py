@@ -104,6 +104,19 @@ class ServeMetrics:
             self._hedges = 0
             self._hedge_wins = 0
             self._replica_trips: dict[str, int] = {}   # rid -> trips
+            # prediction-cache front layer (ISSUE 10): requests served
+            # without touching the pipeline — straight cache hits and
+            # single-flight collapsed followers — plus the batcher's
+            # intra-batch dedup riders. The cache's own hit/miss/evict
+            # counters live in PredictionCache.stats(); these are the
+            # SERVED-population side (they also feed the global
+            # request/latency/by_version/by_dtype accounting, so a
+            # cache hit never silently skips observability).
+            self._cache_hit_requests = 0
+            self._cache_hit_rows = 0
+            self._cache_collapsed_requests = 0
+            self._dedup_requests = 0
+            self._dedup_rows = 0
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -125,6 +138,43 @@ class ServeMetrics:
                 v["requests"] += 1
                 v["rows"] += rows
                 v["lat"].append(seconds)
+
+    def record_cache_hit(self, seconds: float, rows: int = 1,
+                         version: str = None, infer_dtype: str = None,
+                         collapsed: bool = False) -> None:
+        """One request served by the prediction-cache front layer
+        (ISSUE 10) — a straight hit (collapsed=False) or a
+        single-flight follower resolved from its leader's bytes
+        (collapsed=True). Records the SAME populations a computed
+        response gets (global request/row/latency, per-version,
+        per-dtype): the front layer must never make served traffic
+        invisible."""
+        with self._lock:
+            self._lat_s.append(seconds)
+            self._requests += 1
+            self._rows += rows
+            if collapsed:
+                self._cache_collapsed_requests += 1
+            else:
+                self._cache_hit_requests += 1
+            self._cache_hit_rows += rows
+            if version is not None:
+                v = self._version_stats(version)
+                v["requests"] += 1
+                v["rows"] += rows
+                v["lat"].append(seconds)
+            if infer_dtype is not None:
+                s = self._by_dtype.setdefault(
+                    infer_dtype, {"batches": 0, "rows": 0})
+                s["rows"] += rows
+
+    def record_dedup(self, requests: int, rows: int) -> None:
+        """Intra-batch dedup riders (ISSUE 10): identical rows inside
+        one coalesced drain that dispatched once and fanned out —
+        `rows` is the device work the riders did NOT cost."""
+        with self._lock:
+            self._dedup_requests += requests
+            self._dedup_rows += rows
 
     def record_dispatch(self, staging_seconds: float,
                         inflight: int = 1) -> None:
@@ -351,6 +401,12 @@ class ServeMetrics:
                 "hedges": self._hedges,
                 "hedge_wins": self._hedge_wins,
                 "replica_trips": dict(self._replica_trips),
+                "cache_hit_requests": self._cache_hit_requests,
+                "cache_hit_rows": self._cache_hit_rows,
+                "cache_collapsed_requests":
+                    self._cache_collapsed_requests,
+                "dedup_requests": self._dedup_requests,
+                "dedup_rows": self._dedup_rows,
                 "deadline_shed_requests": self._deadline_shed_requests,
                 "deadline_shed_rows": self._deadline_shed_rows,
                 "bisect_splits": self._bisect_splits,
@@ -440,6 +496,19 @@ class ServeMetrics:
                            sorted(c["by_replica"].items())},
             "by_dtype": {d: s for d, s in
                          sorted(c["by_dtype"].items())},
+            # the front layer's served populations (ISSUE 10): the
+            # cache's own hit/miss/evict counters + hit ratio live in
+            # PredictionCache.stats(), surfaced as /metrics' `cache`
+            # block by serve.py — this is the request-accounting side
+            "cache_served": {
+                "hit_requests": c["cache_hit_requests"],
+                "hit_rows": c["cache_hit_rows"],
+                "collapsed_requests": c["cache_collapsed_requests"],
+            },
+            "dedup": {
+                "requests": c["dedup_requests"],
+                "rows": c["dedup_rows"],
+            },
             "fleet": {
                 "failovers": c["failovers"],
                 "failovers_total": sum(c["failovers"].values()),
@@ -482,6 +551,115 @@ class ServeMetrics:
 # The p-keys utils.percentiles emits, as Prometheus quantile labels.
 _PROM_QUANTILES = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
 
+# One-line # HELP text per series (ISSUE 10 satellite): scrapers AND
+# humans read the exposition, and a bare # TYPE line tells neither what
+# the number means. Every emitted dmnist_serve_* family gets a HELP
+# line — names absent here fall back to a generated one, so a new
+# series can never ship help-less.
+_PROM_HELP = {
+    "dmnist_serve_requests_total":
+        "Requests served (computed fan-outs plus cache hits).",
+    "dmnist_serve_rows_total": "Image rows served.",
+    "dmnist_serve_batches_total": "Engine batches fetched.",
+    "dmnist_serve_rejected_requests_total":
+        "Requests shed at the queue watermark (503).",
+    "dmnist_serve_rejected_rows_total":
+        "Rows shed at the queue watermark.",
+    "dmnist_serve_dispatched_rows_total":
+        "Bucket slots executed on the device (incl. padding).",
+    "dmnist_serve_padded_rows_total":
+        "Executed bucket slots that were padding, not real rows.",
+    "dmnist_serve_requests_per_second":
+        "Request rate over the current metrics window.",
+    "dmnist_serve_rows_per_second":
+        "Row rate over the current metrics window.",
+    "dmnist_serve_padding_waste_ratio":
+        "Fraction of executed slots burned on padding.",
+    "dmnist_serve_inflight_max":
+        "Max dispatched-but-unfetched pipeline depth observed.",
+    "dmnist_serve_queue_depth_max":
+        "Max pending-row queue depth observed at batch record time.",
+    "dmnist_serve_latency_ms":
+        "End-to-end request latency quantiles, milliseconds.",
+    "dmnist_serve_staging_ms":
+        "Host staging (pad + device_put + enqueue) quantiles, ms.",
+    "dmnist_serve_fetch_ms":
+        "Blocking device-to-host fetch quantiles, milliseconds.",
+    "dmnist_serve_bucket_dispatches_total":
+        "Batches dispatched per compile bucket.",
+    "dmnist_serve_version_requests_total":
+        "Requests served per model version (canary separability).",
+    "dmnist_serve_replica_batches_total":
+        "Batches computed per fleet replica.",
+    "dmnist_serve_dtype_batches_total":
+        "Batches computed per serving precision.",
+    "dmnist_serve_shadow_errors_total":
+        "Shadow-candidate dispatch/fetch failures (swallowed).",
+    "dmnist_serve_deadline_shed_requests_total":
+        "Requests shed before dispatch on an expired deadline (504).",
+    "dmnist_serve_bisect_splits_total":
+        "Failed segments split in half for poison isolation.",
+    "dmnist_serve_poison_isolated_requests_total":
+        "Culprit requests isolated to a singleton dispatch.",
+    "dmnist_serve_bisect_rescued_requests_total":
+        "Cohort-mates that re-dispatched clean after a split.",
+    "dmnist_serve_dispatch_error_requests_total":
+        "Requests failed at dispatch without isolation.",
+    "dmnist_serve_fetch_error_requests_total":
+        "Requests failed by a batch fetch error.",
+    "dmnist_serve_breaker_trips_total": "Circuit-breaker trips.",
+    "dmnist_serve_breaker_version_trips_total":
+        "Circuit-breaker trips attributed per model version.",
+    "dmnist_serve_rollbacks_total":
+        "Completed automatic rollbacks to a healthy resident.",
+    "dmnist_serve_failovers_total":
+        "Batches rescued on a sibling replica, by failure kind.",
+    "dmnist_serve_hedges_total": "Hedged duplicate dispatches raced.",
+    "dmnist_serve_hedge_wins_total":
+        "Hedge races the duplicate won (tail bought back).",
+    "dmnist_serve_replica_trips_total":
+        "Per-replica circuit-breaker trips.",
+    "dmnist_serve_stage_duration_ms":
+        "Per-stage request durations derived from trace spans, ms.",
+    "dmnist_serve_pending_rows": "Rows pending in the batcher queue.",
+    "dmnist_serve_inflight_batches":
+        "Dispatch segments popped but not yet fully resolved.",
+    # prediction-cache front layer (ISSUE 10)
+    "dmnist_serve_cache_hits_total":
+        "Prediction-cache lookups served from a cached response.",
+    "dmnist_serve_cache_hit_rows_total":
+        "Rows served straight from the prediction cache.",
+    "dmnist_serve_cache_misses_total":
+        "Prediction-cache lookups that missed.",
+    "dmnist_serve_cache_collapsed_total":
+        "Identical concurrent misses collapsed onto one in-flight "
+        "computation (single-flight followers).",
+    "dmnist_serve_cache_inserts_total":
+        "Computed responses inserted into the prediction cache.",
+    "dmnist_serve_cache_evictions_total":
+        "LRU evictions past the prediction-cache capacity.",
+    "dmnist_serve_cache_invalidations_total":
+        "Whole-cache invalidations (promote/rollback/dtype swap).",
+    "dmnist_serve_cache_stale_drops_total":
+        "Inserts or reads refused because the computing version no "
+        "longer matched the live route.",
+    "dmnist_serve_cache_hit_ratio":
+        "Hits over lookups since process start (None until traffic).",
+    "dmnist_serve_cache_entries": "Live prediction-cache entries.",
+    "dmnist_serve_cache_inflight_keys":
+        "Single-flight computations currently in flight.",
+    "dmnist_serve_dedup_requests_total":
+        "Intra-batch dedup riders resolved from a representative's "
+        "dispatch.",
+    "dmnist_serve_dedup_rows_total":
+        "Device rows the intra-batch dedup did not dispatch.",
+}
+
+
+def _prom_help(name: str) -> str:
+    return _PROM_HELP.get(
+        name, name.removeprefix("dmnist_serve_").replace("_", " ") + ".")
+
 
 def _prom_escape(value: str) -> str:
     return (str(value).replace("\\", r"\\").replace('"', r'\"')
@@ -498,23 +676,28 @@ def _prom_line(name: str, labels: dict, value) -> str:
 
 def prometheus_exposition(snapshot: dict,
                           trace_stages: dict = None,
-                          gauges: dict = None) -> str:
+                          gauges: dict = None,
+                          cache: dict = None) -> str:
     """Flatten a ServeMetrics snapshot() into Prometheus text format
     (`GET /metrics?format=prometheus`, or an `Accept: text/plain`
-    scrape): stably-named counters/gauges/summaries with `# TYPE`
-    lines, derived from the SAME snapshot the JSON surface serves — a
-    scrape surface for the fleet story without a second accounting
-    path. `trace_stages` (Tracer.snapshot()["stages"], optional) adds
-    the per-stage duration histograms derived from the ISSUE 9 spans;
-    `gauges` adds point-in-time pipeline gauges (queue depth, in-flight
-    window) the snapshot itself does not carry. None-valued samples
-    (empty percentile windows) are skipped, never emitted as 0."""
+    scrape): stably-named counters/gauges/summaries with `# HELP` +
+    `# TYPE` lines, derived from the SAME snapshot the JSON surface
+    serves — a scrape surface for the fleet story without a second
+    accounting path. `trace_stages` (Tracer.snapshot()["stages"],
+    optional) adds the per-stage duration histograms derived from the
+    ISSUE 9 spans; `gauges` adds point-in-time pipeline gauges (queue
+    depth, in-flight window) the snapshot itself does not carry;
+    `cache` (PredictionCache.stats(), optional) adds the ISSUE 10
+    hit/miss/collapse/evict counters and hit ratio. None-valued
+    samples (empty percentile windows, a pre-traffic hit ratio) are
+    skipped, never emitted as 0."""
     out: list[str] = []
 
     def emit(name: str, mtype: str, samples) -> None:
         rows = [(labels, v) for labels, v in samples if v is not None]
         if not rows:
             return
+        out.append(f"# HELP {name} {_prom_help(name)}")
         out.append(f"# TYPE {name} {mtype}")
         for labels, v in rows:
             out.append(_prom_line(name, labels, v))
@@ -602,12 +785,44 @@ def prometheus_exposition(snapshot: dict,
     emit("dmnist_serve_replica_trips_total", "counter",
          [({"replica": r}, n) for r, n in
           fleet.get("replica_trips_by_replica", {}).items()])
+    # Prediction-cache front layer (ISSUE 10): the cache's own
+    # counters (hit/miss/collapse/insert/evict/invalidate/stale) plus
+    # hit ratio, and the batcher's dedup counters from the snapshot.
+    dd = s.get("dedup", {})
+    emit("dmnist_serve_dedup_requests_total", "counter",
+         [({}, dd.get("requests"))])
+    emit("dmnist_serve_dedup_rows_total", "counter",
+         [({}, dd.get("rows"))])
+    if cache:
+        emit("dmnist_serve_cache_hits_total", "counter",
+             [({}, cache.get("hits"))])
+        emit("dmnist_serve_cache_hit_rows_total", "counter",
+             [({}, cache.get("hit_rows"))])
+        emit("dmnist_serve_cache_misses_total", "counter",
+             [({}, cache.get("misses"))])
+        emit("dmnist_serve_cache_collapsed_total", "counter",
+             [({}, cache.get("collapsed"))])
+        emit("dmnist_serve_cache_inserts_total", "counter",
+             [({}, cache.get("inserts"))])
+        emit("dmnist_serve_cache_evictions_total", "counter",
+             [({}, cache.get("evictions"))])
+        emit("dmnist_serve_cache_invalidations_total", "counter",
+             [({}, cache.get("invalidations"))])
+        emit("dmnist_serve_cache_stale_drops_total", "counter",
+             [({}, cache.get("stale_drops"))])
+        emit("dmnist_serve_cache_entries", "gauge",
+             [({}, cache.get("entries"))])
+        emit("dmnist_serve_cache_inflight_keys", "gauge",
+             [({}, cache.get("inflight_keys"))])
+        emit("dmnist_serve_cache_hit_ratio", "gauge",
+             [({}, cache.get("hit_ratio"))])
     for name, value in (gauges or {}).items():
         emit(f"dmnist_serve_{name}", "gauge", [({}, value)])
     # Per-stage duration histograms derived from the ISSUE 9 spans —
     # cumulative buckets per the Prometheus histogram contract.
     if trace_stages:
         name = "dmnist_serve_stage_duration_ms"
+        out.append(f"# HELP {name} {_prom_help(name)}")
         out.append(f"# TYPE {name} histogram")
         for stage, h in sorted(trace_stages.items()):
             cum = 0

@@ -338,6 +338,12 @@ class ModelRegistry:
         self._state = make_lock("registry.state")
         self._compiles = CompileCounter.instance()
         self._auto_id = 0
+        # The prediction cache to invalidate on every live-route change
+        # (ISSUE 10): promote, rollback and dtype activation all funnel
+        # through _route_set("live", ...), so one hook site covers the
+        # whole surface. None = no cache installed (every pre-ISSUE-10
+        # caller).
+        self._cache = None
         # Lifecycle events an operator must be able to reconstruct
         # AFTER the fact (ISSUE 5): circuit-breaker rollbacks above all.
         # Bounded; surfaced by events(), describe() and /healthz.
@@ -744,6 +750,13 @@ class ModelRegistry:
 
     # -- routing -----------------------------------------------------------
 
+    def set_cache(self, cache) -> None:
+        """Install the prediction cache this registry invalidates on
+        every live-route change (ISSUE 10). Any object with an
+        `invalidate(reason=)` method works — serve/cache.py's
+        PredictionCache in production."""
+        self._cache = cache
+
     def _route_set(self, kind: str, mv: ModelVersion,
                    fraction: Optional[float] = None,
                    engines: Optional[list] = None) -> None:
@@ -752,11 +765,22 @@ class ModelRegistry:
         batch dispatches mid-roll); a plain Router takes the single
         engine — same call sites, no drift between the two shapes.
         `engines` overrides the version's base engine list (a dtype
-        variant routing under the same version label)."""
+        variant routing under the same version label).
+
+        A live-target change also invalidates the prediction cache
+        ATOMICALLY with the swap (promote/rollback hold _state across
+        both, so no lookup can land between the new route and the
+        flush): cached bytes are keyed by the live route, so entries
+        written under the old route are unreachable the instant
+        set_live returns — the invalidation reclaims their memory and
+        bumps the cache epoch so in-flight single-flight inserts that
+        raced the swap are dropped, never served (ISSUE 10)."""
         engines = mv.engines if engines is None else engines
         target = (list(engines) if self.n_replicas > 1 else engines[0])
         if kind == "live":
             self.router.set_live(target, mv.version)
+            if self._cache is not None:
+                self._cache.invalidate(reason=f"live -> {mv.version}")
         elif kind == "shadow":
             self.router.set_shadow(target, mv.version, fraction)
         else:

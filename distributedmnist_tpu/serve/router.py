@@ -214,6 +214,18 @@ class Router:
             return None
         return getattr(live.engine, "infer_dtype", None)
 
+    def live_route(self) -> tuple:
+        """(live version, live infer_dtype) under ONE lock crossing —
+        the prediction cache's key basis (ISSUE 10). Two separate
+        live_version()/live_infer_dtype() reads could interleave with
+        a promote and key an entry on a (version, dtype) pair that was
+        never live together; this read cannot."""
+        with self._lock:
+            live = self._live
+        if live is None:
+            return (None, None)
+        return (live.version, getattr(live.engine, "infer_dtype", None))
+
     def routes(self) -> dict:
         """The current routing table (for GET /models and tests)."""
         with self._lock:

@@ -72,6 +72,14 @@ Span name table (stage -> what it times -> mechanism):
     fleet.hedge             the hedged-tail race (winner tagged)
     fleet.hedge.primary     the overdue primary's fetch arm
     fleet.hedge.duplicate   the duplicate's dispatch + fetch arm
+    cache.lookup            the prediction-cache front's content-hash
+                            lookup (ISSUE 10; collapsed=True when the
+                            miss joined an in-flight leader)
+    cache.hit               served from the cache — zero pipeline work
+    cache.collapse          a single-flight follower's wait on its
+                            leader's computation
+    batch.dedup             intra-batch dedup riders collapsed onto a
+                            representative dispatch (zero-width marker)
 """
 
 from __future__ import annotations
@@ -113,6 +121,11 @@ STAGE_OF = {
     "fleet.hedge": ("hedge", 70),
     "fleet.hedge.primary": ("hedge", 75),
     "fleet.hedge.duplicate": ("hedge", 75),
+    # prediction-cache front layer (ISSUE 10): a hit's whole budget is
+    # the lookup; a collapsed follower's is the wait on its leader
+    "cache.lookup": ("cache", 90),
+    "cache.hit": ("cache", 90),
+    "cache.collapse": ("cache", 85),
 }
 
 

@@ -106,6 +106,23 @@ GET /models. auto serves the cheapest parity-passing variant by the
 warmup-measured bucket cost tables. /healthz and GET /models report
 live_infer_dtype so an operator can tell which precision is live.
 
+Prediction cache (ISSUE 10, serve/cache.py): --serve-cache puts a
+content-hash front layer before the batcher — a bounded LRU keyed by
+(live version, infer_dtype, sha256 of the input bytes). Repeats of a
+hot key are served sub-millisecond with ZERO device work (still
+version-tagged, still metered, still X-Trace-Id'd); concurrent
+identical misses collapse onto ONE in-flight computation
+(single-flight: the leader dispatches, followers share its bytes, a
+leader failure fails them with the leader's error and is never
+cached). The registry invalidates the cache atomically on every
+promote/rollback/dtype activation, entries re-check their computing
+version at read, so a stale-version hit is impossible.
+--serve-cache-capacity bounds resident entries; --serve-dedup
+additionally collapses identical rows inside one coalesced drain
+(dispatch once, fan out). /metrics exposes hit/miss/collapse/evict
+counters and the hit ratio (JSON `cache` block + dmnist_serve_cache_*
+Prometheus series).
+
 Tracing (ISSUE 9, serve/trace.py): --serve-trace installs the
 per-request span tracer. Each request's path (queue wait, staging,
 device window, fetch, rescues, bisect retries) is recorded as a span
@@ -319,7 +336,8 @@ def _sanitizer_block() -> dict:
 def _http_serve(batcher, metrics, registry, state, port: int,
                 metrics_every: float, request_timeout: float,
                 warm, retry_after_cap_s: float = 30.0,
-                infer_dtype_choice: str = "float32") -> dict:
+                infer_dtype_choice: str = "float32",
+                front=None, cache=None) -> dict:
     import concurrent.futures
     import math
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -330,6 +348,11 @@ def _http_serve(batcher, metrics, registry, state, port: int,
     from distributedmnist_tpu.serve import trace as trace_lib
 
     max_body = registry.factory.max_batch * IMAGE_BYTES
+    # The submit target: the prediction-cache front layer when
+    # --serve-cache is on (ISSUE 10 — hits resolve without touching
+    # the pipeline, identical concurrent misses collapse), the bare
+    # batcher otherwise. Queue gauges always read the batcher itself.
+    submit_to = front if front is not None else batcher
     # The replica fleet, when serving one (--serve-replicas >= 2):
     # admin drain/rejoin and the /metrics fleet block hang off it.
     fleet = (registry.router
@@ -422,7 +445,9 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                             "pending_rows": batcher.pending_rows(),
                             "inflight_batches":
                                 batcher.inflight_batches(),
-                        }))
+                        },
+                        cache=(cache.stats() if cache is not None
+                               else None)))
                     return
                 # The full ServeMetrics snapshot PLUS point-in-time
                 # pipeline gauges and the adaptive controller's state —
@@ -439,6 +464,10 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 payload["adaptive"] = (
                     batcher.controller.snapshot()
                     if batcher.controller is not None else None)
+                # the prediction-cache front layer's counters + hit
+                # ratio (ISSUE 10; None without --serve-cache)
+                payload["cache"] = (cache.stats()
+                                    if cache is not None else None)
                 # the breaker's live windows (per-version volume /
                 # failures / cooldown) — the resilience counters in the
                 # snapshot say what already happened, this says what
@@ -666,7 +695,11 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # handler thread must come back (504) rather than be
                 # held forever — ThreadingHTTPServer has no thread cap,
                 # so unbounded waiters pile up until exhaustion.
-                fut = batcher.submit(x, deadline_s=deadline_s)
+                # submit through the cache front when installed: a hit
+                # comes back already resolved (still version-tagged and
+                # X-Trace-Id'd), a collapsed miss shares its leader's
+                # computation, everything else flows to the batcher
+                fut = submit_to.submit(x, deadline_s=deadline_s)
                 logits = fut.result(timeout=(
                     request_timeout if budget_s is None
                     else min(request_timeout, budget_s)))
@@ -795,9 +828,12 @@ def _http_serve(batcher, metrics, registry, state, port: int,
     finally:
         stop.set()
         srv.server_close()
-    return {"metric": "serve_summary", "port": bound,
-            "live_version": registry.live_version(),
-            **metrics.snapshot()}
+    summary = {"metric": "serve_summary", "port": bound,
+               "live_version": registry.live_version(),
+               **metrics.snapshot()}
+    if cache is not None:
+        summary["cache"] = cache.stats()
+    return summary
 
 
 def main(argv=None) -> int:
@@ -854,6 +890,9 @@ def main(argv=None) -> int:
     if (args.serve_trace_capacity is not None
             and args.serve_trace_capacity < 1):
         p.error("--serve-trace-capacity must be >= 1")
+    if (args.serve_cache_capacity is not None
+            and args.serve_cache_capacity < 1):
+        p.error("--serve-cache-capacity must be >= 1")
     if args.serve_faults is not None:
         # a malformed chaos schedule is a usage error NOW — it must
         # never boot a server that silently injects nothing
@@ -898,7 +937,21 @@ def main(argv=None) -> int:
                              slo_ms=cfg.serve_slo_ms,
                              adaptive=cfg.serve_adaptive,
                              resilience=resilience,
+                             dedup=cfg.serve_dedup,
                              metrics=metrics).start()
+    # The prediction cache + single-flight front layer (ISSUE 10):
+    # front is the submit target (== batcher when --serve-cache is
+    # off); the registry invalidates the cache atomically on every
+    # live-route change via the set_cache hook build_cache_front
+    # installs.
+    from distributedmnist_tpu.serve import build_cache_front
+    front, cache = build_cache_front(cfg, batcher, router, registry,
+                                     metrics=metrics)
+    if cache is not None:
+        log.info("prediction cache ACTIVE (capacity %d entries, "
+                 "dedup %s): hits skip the pipeline, identical "
+                 "concurrent misses collapse", cfg.serve_cache_capacity,
+                 "on" if cfg.serve_dedup else "off")
     log.info("dispatch pipeline depth: %d; buckets %s",
              batcher.max_inflight, list(factory.buckets))
     state = ServerState()
@@ -932,8 +985,10 @@ def main(argv=None) -> int:
         if args.port is None:
             warm()                       # synchronous: the gate is cheap
             state.mark_running()
-            summary = _selftest(batcher, metrics, args.selftest or 256,
+            summary = _selftest(front, metrics, args.selftest or 256,
                                 factory.max_batch)
+            if cache is not None:
+                summary["cache"] = cache.stats()
         else:
             summary = _http_serve(batcher, metrics, registry, state,
                                   args.port, args.metrics_every,
@@ -941,7 +996,8 @@ def main(argv=None) -> int:
                                   retry_after_cap_s=(
                                       cfg.serve_retry_after_cap_s),
                                   infer_dtype_choice=(
-                                      cfg.serve_infer_dtype))
+                                      cfg.serve_infer_dtype),
+                                  front=front, cache=cache)
     finally:
         batcher.stop()
     # Sanitizer verdict AFTER stop() (DMNIST_SANITIZE=1 runs): the

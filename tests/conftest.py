@@ -55,6 +55,14 @@ def pytest_configure(config):
         "suite")
     config.addinivalue_line(
         "markers",
+        "cache: prediction-cache / request-dedup test (serve/cache.py: "
+        "the content-hash LRU front layer, single-flight collapse, "
+        "invalidation-race coverage, the batcher's intra-batch dedup); "
+        "cheap and deterministic, runs in tier-1 under the serve "
+        "sanitizer fixture — `-m cache` selects just this suite "
+        "(scripts/tier1.sh notes the inclusion)")
+    config.addinivalue_line(
+        "markers",
         "trace: request-tracing test (serve/trace.py: span trees, "
         "sampling/exemplar retention, Chrome export, stage "
         "attribution, the /trace + Prometheus surfaces); cheap and "

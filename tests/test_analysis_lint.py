@@ -241,6 +241,64 @@ def test_dml007_scope_is_serve_and_trace_py_exempt():
     assert _rules(src, "serve.py") == ["DML007"]
 
 
+# -- DML008: cache state mutated outside the cache's named lock ------------
+
+
+def test_dml008_unlocked_mutations_flagged():
+    """Every mutation shape on the cache state containers is flagged
+    when it sits outside a `with <...>_lock:` block."""
+    for stmt in ("self._entries.pop(k)",
+                 "self._entries[k] = v",
+                 "self._entries.move_to_end(k)",
+                 "self._flights.clear()",
+                 "del self._flights[k]",
+                 "self._flights.setdefault(k, f)"):
+        src = f"def f(self, k, v, f):\n    {stmt}\n"
+        assert _rules(src) == ["DML008"], stmt
+
+
+def test_dml008_under_named_lock_is_clean():
+    src = ("def f(self, k, v):\n"
+           "    with self._lock:\n"
+           "        self._entries[k] = v\n"
+           "        self._flights.pop(k, None)\n")
+    assert _rules(src) == []
+    # a front-layer compound op holding the CACHE's lock is clean too
+    src2 = ("def g(cache, k, v):\n"
+            "    with cache._lock:\n"
+            "        cache._entries[k] = v\n")
+    assert _rules(src2) == []
+
+
+def test_dml008_reads_and_rebinding_are_clean():
+    src = ("def f(self, k):\n"
+           "    e = self._entries.get(k)\n"
+           "    n = len(self._entries)\n"
+           "    return e, n\n"
+           "def ctor(self):\n"
+           "    self._entries = {}\n"       # constructor rebinding
+           "    self._flights = {}\n")
+    assert _rules(src) == []
+
+
+def test_dml008_scope_is_serve_package_only():
+    src = "def f(self, k, v):\n    self._entries[k] = v\n"
+    assert _rules(src, "tests/test_serve_cache.py") == []
+    assert _rules(src, "bench.py") == []
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+    assert _rules(src, "distributedmnist_tpu/serve/cache.py") == [
+        "DML008"]
+
+
+def test_dml008_wrong_lock_shape_not_enough():
+    """A `with` that is not a lock (an Event, a file) does not count as
+    protection."""
+    src = ("def f(self, k, v):\n"
+           "    with self._gate:\n"
+           "        self._entries[k] = v\n")
+    assert _rules(src) == ["DML008"]
+
+
 # -- allowlist pragma ------------------------------------------------------
 
 

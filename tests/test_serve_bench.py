@@ -243,6 +243,121 @@ def test_bench_serve_baseline_dtype_mismatch_refused(tmp_path):
     assert not out.stdout.strip(), "refusal must not emit a record"
 
 
+def test_bench_zipf_flags_validated():
+    """--zipf / --zipf-cache-off / --serve-cache-capacity are
+    serve-only flags with the usual exit-2 validation."""
+    out = _run_cli("bench.py", ["throughput", "--zipf"], timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("bench.py", ["smoke", "--serve-cache-capacity", "64"],
+                   timeout=60)
+    assert out.returncode == 2
+    out = _run_cli("bench.py", ["serve", "--serve-cache-capacity", "0"],
+                   timeout=60)
+    assert out.returncode == 2
+    # --zipf-cache-off without --zipf is a contradiction, not a no-op
+    out = _run_cli("bench.py", ["serve", "--zipf-cache-off"], timeout=60)
+    assert out.returncode == 2
+
+
+@pytest.mark.cache
+def test_bench_serve_zipf_contract():
+    """`bench.py serve --zipf` (the acceptance-criteria spelling): the
+    record carries the hot-key leg — cache-off vs cache-on over the
+    same seeded Zipf mix, hit ratio >= 0.5, strictly fewer device
+    dispatches with the cache on, byte-identical cached responses
+    (parity probes), single-flight collapse counters, and zero
+    steady-state recompiles. The >= 2x goodput bar applies to the
+    real-duration artifact runs; here the structure and the
+    hit/dispatch/parity invariants are asserted."""
+    out = _run_cli("bench.py", ["serve", "--zipf"] + SERVE_ARGS)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip())
+    d = rec["detail"]
+    assert d["recompiles_after_warmup"] == 0
+    z = d["zipf"]
+    assert z["cache_enabled"] is True
+    assert z["distinct_keys"] == 64 and z["zipf_s"] == 1.1
+    off, on = z["cache_off"], z["cache_on"]
+    assert off["rows_per_sec"] > 0 and on["rows_per_sec"] > 0
+    assert z["hit_ratio"] is not None and z["hit_ratio_ok"], z
+    assert z["goodput_x"] is not None and z["goodput_x"] > 0
+    assert z["device_dispatch_lower"], (
+        f"cache on must dispatch strictly fewer batches: "
+        f"{z['device_dispatches_on']} vs {z['device_dispatches_off']}")
+    assert z["parity_probes"] >= 1 and z["parity_ok"] is True
+    cache = on["cache"]
+    assert cache["hits"] > 0 and cache["inserts"] > 0
+    assert cache["hit_ratio"] == z["hit_ratio"]
+    assert z["p99_off_ms"] is not None and z["p99_on_ms"] is not None
+    # baseline delta rows exist for the zipf signals (None-vs-None
+    # handling is the chaos rows' precedent; here just shape)
+    assert "single_flight_collapsed" in z
+
+
+def test_bench_serve_baseline_zipf_cache_mismatch_refused(tmp_path):
+    """A cache-on zipf run must refuse a --baseline whose zipf leg ran
+    cache-off (and vice versa) — the same exit-4 semantics as
+    cross-silicon and cross-dtype deltas, before any load phase."""
+    base = tmp_path / "BENCH_serve_r97.json"
+    base.write_text(json.dumps({
+        "metric": "serve_images_per_sec_per_chip", "value": 100.0,
+        "detail": {"host": {"device_kind": "cpu"},
+                   "zipf": {"cache_enabled": False},
+                   "recompiles_after_warmup": 0,
+                   "closed_loop": {"latency_ms": {"p99": 1.0}}}}))
+    out = _run_cli("bench.py", ["serve", "--zipf", "--baseline",
+                                str(base)] + SERVE_ARGS)
+    assert out.returncode == 4, (out.returncode, out.stderr[-500:])
+    assert "cache_enabled" in out.stderr
+    assert not out.stdout.strip(), "refusal must not emit a record"
+
+
+@pytest.mark.cache
+def test_serve_http_cache_end_to_end():
+    """serve.py --serve-cache --serve-dedup --serve-trace: repeated
+    identical POST /predict bodies hit the cache (visible in /metrics'
+    `cache` block and the Prometheus cache series), hit responses stay
+    version-tagged AND carry X-Trace-Id, and a model roll via the
+    admin promote invalidates the cache."""
+    env, repo = worker_env()
+    proc, port = _start_server(
+        repo, env, extra=["--serve-cache", "--serve-dedup",
+                          "--serve-trace"])
+    try:
+        base = f"http://127.0.0.1:{port}"
+        ok = _wait_healthy(base)
+        body = np.full((2, 784), 37, np.uint8).tobytes()
+        rs = []
+        for _ in range(3):
+            resp = urllib.request.urlopen(f"{base}/predict", data=body,
+                                          timeout=30)
+            assert resp.headers.get("X-Trace-Id")
+            rs.append(json.loads(resp.read()))
+        assert all(r["classes"] == rs[0]["classes"] for r in rs)
+        assert all(r["version"] == ok["live_version"] for r in rs)
+        m = _get_json(f"{base}/metrics")
+        c = m["cache"]
+        assert c["hits"] >= 1 and c["hit_ratio"] > 0
+        assert c["entries"] >= 1
+        prom = urllib.request.urlopen(
+            f"{base}/metrics?format=prometheus", timeout=10
+        ).read().decode()
+        assert "dmnist_serve_cache_hits_total" in prom
+        assert "# HELP dmnist_serve_cache_hits_total" in prom
+        assert "dmnist_serve_cache_hit_ratio" in prom
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert proc.returncode == 0
+    records = [json.loads(l) for l in out.splitlines() if l.strip()]
+    summary = [r for r in records if r.get("metric") == "serve_summary"]
+    assert summary and summary[-1]["cache"]["hits"] >= 1
+
+
 @pytest.mark.quant
 def test_bench_serve_dtype_sweep_contract():
     """`bench.py serve --dtype-sweep` (the acceptance-criteria

@@ -1,0 +1,566 @@
+"""serve/cache.py (ISSUE 10): the prediction cache + single-flight
+front layer and the batcher's intra-batch dedup.
+
+Covers the LRU/eviction/invalidation contract, single-flight collapse
+(N concurrent identical requests -> exactly ONE engine dispatch,
+asserted on the engine call log, with a stub AND a real engine), the
+leader-failure semantics (followers share the leader's error, errors
+are never cached), the invalidation races the stale-hit guarantee
+hangs on (promote/rollback/dtype-activation concurrent with lookups
+and an in-flight leader — version captured at insert, checked at
+read), cache-hit observability (metrics populations + trace exemplars
+are never skipped on the fast path), and the dedup fan-out. Every test
+runs under the conftest serve sanitizer, so the new cache.state lock's
+ordering edges are audited on every run."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
+                                        content_key)
+from distributedmnist_tpu.serve import trace as trace_lib
+from distributedmnist_tpu.serve.cache import CacheFront, PredictionCache
+from distributedmnist_tpu.serve.resilience import DeadlineExceeded
+from tests.test_serve_batcher import StubEngine
+
+pytestmark = pytest.mark.cache
+
+
+class StubRouter(StubEngine):
+    """Router-shaped StubEngine: a flippable live route, version-tagged
+    handles, and fetch() results that ENCODE the computing version (an
+    offset per version), so a stale-version byte served under a fresh
+    version tag is detectable by value."""
+
+    OFFSETS = {"v1": 0.0, "v2": 1000.0}
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._route = ("v1", "float32")
+        self._route_lock = threading.Lock()
+
+    def set_live_route(self, version, infer_dtype="float32"):
+        with self._route_lock:
+            self._route = (version, infer_dtype)
+
+    def live_version(self):
+        return self._route[0]
+
+    def live_infer_dtype(self):
+        return self._route[1]
+
+    def live_route(self):
+        with self._route_lock:
+            return self._route
+
+    def dispatch(self, x):
+        h = super().dispatch(x)
+        with self._route_lock:
+            h.version, h.infer_dtype = self._route
+        return h
+
+    def fetch(self, handle):
+        out = super().fetch(handle)
+        return out + self.OFFSETS.get(handle.version, 0.0)
+
+
+def _rows(rng, n):
+    return rng.integers(0, 256, (n, 28, 28, 1)).astype(np.uint8)
+
+
+def _front(router, metrics=None, capacity=64, dedup=True, **batcher_kw):
+    b = DynamicBatcher(router, max_wait_us=1000, queue_depth=1024,
+                       metrics=metrics, dedup=dedup,
+                       **batcher_kw).start()
+    cache = PredictionCache(capacity)
+    return CacheFront(b, router, cache, metrics=metrics), b, cache
+
+
+# -- PredictionCache unit contract -----------------------------------------
+
+
+def test_content_key_identity():
+    rng = np.random.default_rng(0)
+    x = _rows(rng, 3)
+    k1 = content_key("v1", "float32", x)
+    k2 = content_key("v1", "float32", x.copy())
+    assert k1 == k2                       # same bytes, same key
+    assert content_key("v2", "float32", x) != k1     # version in key
+    assert content_key("v1", "int8", x) != k1        # dtype in key
+    y = x.copy()
+    y[0, 0, 0, 0] ^= 1
+    assert content_key("v1", "float32", y) != k1     # content in key
+
+
+def test_lru_bounds_evictions_and_recency():
+    rng = np.random.default_rng(1)
+    c = PredictionCache(capacity=3)
+    xs = [_rows(rng, 1) for _ in range(4)]
+    keys = [content_key("v1", None, x) for x in xs]
+    logits = [np.full((1, 10), float(i)) for i in range(4)]
+    for k, lg in zip(keys[:3], logits[:3]):
+        assert c.insert(k, lg, "v1", None)
+    # touch key 0 so key 1 is the LRU victim
+    assert c.lookup(keys[0]) is not None
+    assert c.insert(keys[3], logits[3], "v1", None)
+    st = c.stats()
+    assert st["entries"] == 3 and st["evictions"] == 1
+    assert c.lookup(keys[1]) is None      # evicted (least recent)
+    assert c.lookup(keys[0]) is not None  # refreshed survivor
+    # a hit returns a COPY: mutating it must not corrupt the cache
+    got = c.lookup(keys[0])
+    got[:] = -1.0
+    assert float(c.lookup(keys[0])[0, 0]) == 0.0
+
+
+def test_insert_checks_computing_version_and_epoch():
+    """Version captured at insert, checked there AND at read: a result
+    computed by a version other than the key's (canary, mid-promote
+    race) is refused; so is an insert whose flight predates an
+    invalidation epoch bump."""
+    rng = np.random.default_rng(2)
+    key = content_key("v1", None, _rows(rng, 1))
+    c = PredictionCache(capacity=4)
+    assert not c.insert(key, np.zeros((1, 10)), "v2", None)
+    assert c.stats()["stale_drops"] == 1 and c.stats()["entries"] == 0
+    epoch = c.epoch()
+    c.invalidate("promote")
+    assert not c.insert(key, np.zeros((1, 10)), "v1", None, epoch=epoch)
+    assert c.stats()["stale_drops"] == 2
+    assert c.insert(key, np.zeros((1, 10)), "v1", None, epoch=c.epoch())
+    assert c.stats()["entries"] == 1
+    c.invalidate("rollback")
+    st = c.stats()
+    assert st["entries"] == 0 and st["invalidations"] == 2
+
+
+def test_capacity_validated():
+    with pytest.raises(ValueError, match="capacity"):
+        PredictionCache(capacity=0)
+
+
+# -- front layer: hit / miss / observability -------------------------------
+
+
+def test_hit_serves_without_second_dispatch_and_is_byte_identical(rng):
+    eng = StubRouter(max_batch=16)
+    m = ServeMetrics()
+    front, b, cache = _front(eng, metrics=m)
+    try:
+        x = _rows(rng, 3)
+        first = front.submit(x).result(timeout=10)
+        hit_fut = front.submit(x)
+        got = hit_fut.result(timeout=10)
+        assert got.tobytes() == first.tobytes()
+        assert eng.calls == [3]            # ONE dispatch, the miss's
+        assert hit_fut.version == "v1"     # hits stay version-tagged
+        st = cache.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["hit_ratio"] == 0.5
+        # observability satellite: the hit recorded the SAME
+        # populations a computed response gets — global requests,
+        # per-version, per-dtype — plus the cache-served split
+        snap = m.snapshot()
+        assert snap["requests"] == 2
+        assert snap["by_version"]["v1"]["requests"] == 2
+        assert snap["by_dtype"]["float32"]["rows"] >= 3
+        assert snap["cache_served"]["hit_requests"] == 1
+    finally:
+        b.stop()
+
+
+def test_cache_hit_never_skips_tracing_and_over_slo_hits_are_exemplars(
+        rng):
+    """A hit must carry X-Trace-Id (trace_id on the future), finish its
+    trace with cache.lookup/cache.hit spans, and — when over SLO —
+    land in the exemplar ring like any other slow request."""
+    tracer = trace_lib.install(trace_lib.Tracer(slo_ms=1e-6, seed=5))
+    eng = StubRouter(max_batch=16)
+    front, b, cache = _front(eng)
+    try:
+        x = _rows(rng, 2)
+        front.submit(x).result(timeout=10)
+        hit_fut = front.submit(x)
+        hit_fut.result(timeout=10)
+        assert hit_fut.trace_id is not None
+        snap = tracer.snapshot()
+        assert snap["requests_finished"] >= 2
+        assert snap["open_spans"] == 0
+        # an slo of 1 ns makes every request an exemplar — the hit
+        # trace is retained and carries its cache spans
+        hits = [t for t in tracer.traces()
+                if any(s["name"] == "cache.hit" for s in t["spans"])]
+        assert hits, "cache-hit trace was not retained"
+        names = {s["name"] for s in hits[-1]["spans"]}
+        assert {"request", "cache.lookup", "cache.hit"} <= names
+        assert hits[-1]["over_slo"] is True
+        # the stage histogram learned the cache stages too
+        assert "cache.lookup" in snap["stages"]
+    finally:
+        b.stop()
+        trace_lib.uninstall()
+
+
+def test_front_deadline_expired_sheds_before_hashing(rng):
+    eng = StubRouter(max_batch=16)
+    m = ServeMetrics()
+    front, b, cache = _front(eng, metrics=m)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            front.submit(_rows(rng, 1),
+                         deadline_s=time.monotonic() - 0.1)
+        st = cache.stats()
+        assert st["hits"] == st["misses"] == 0    # never looked up
+        assert m.snapshot()["resilience"]["deadline_shed_requests"] == 1
+    finally:
+        b.stop()
+
+
+def test_front_passes_through_with_no_live_version(rng):
+    """Warming server: nothing to key on — the front delegates and the
+    batcher's semantics (here: a bare stub serve) are untouched."""
+    eng = StubRouter(max_batch=16)
+    eng.set_live_route(None, None)
+    front, b, cache = _front(eng)
+    try:
+        out = front.submit(_rows(rng, 2)).result(timeout=10)
+        assert out.shape == (2, 10)
+        st = cache.stats()
+        assert st["hits"] == st["misses"] == 0
+    finally:
+        b.stop()
+
+
+# -- single-flight collapse ------------------------------------------------
+
+
+def test_single_flight_exactly_one_dispatch_stub(rng):
+    """ISSUE 10 acceptance, deterministic form: N concurrent identical
+    misses produce exactly ONE engine dispatch (engine call log), all
+    N futures resolve with the same bytes, followers are counted as
+    collapsed."""
+    gate = threading.Event()
+    eng = StubRouter(max_batch=16, gate=gate)
+    m = ServeMetrics()
+    front, b, cache = _front(eng, metrics=m)
+    try:
+        x = _rows(rng, 2)
+        futs = [front.submit(x) for _ in range(6)]
+        assert eng.in_call.wait(timeout=10)
+        gate.set()
+        outs = [f.result(timeout=10) for f in futs]
+        assert len({o.tobytes() for o in outs}) == 1
+        assert eng.calls == [2], (
+            f"expected ONE dispatch for 6 identical requests, got "
+            f"{eng.calls}")
+        st = cache.stats()
+        assert st["collapsed"] == 5
+        assert st["inserts"] == 1 and st["inflight_keys"] == 0
+        # followers are version-tagged and metered like hits — incl.
+        # the per-dtype population (the observability satellite covers
+        # collapsed traffic too, not only straight hits)
+        assert all(f.version == "v1" for f in futs)
+        snap = m.snapshot()
+        assert snap["cache_served"]["collapsed_requests"] == 5
+        assert snap["by_dtype"]["float32"]["rows"] >= 10  # 5 x 2 rows
+        # each future holds its OWN array: mutating one result must
+        # not corrupt a concurrent identical request's bytes
+        a, bb = futs[0].result(), futs[1].result()
+        a[:] = -1.0
+        assert bb[0, 0] != -1.0
+    finally:
+        b.stop()
+
+
+def test_single_flight_one_dispatch_real_engine(eight_devices, rng):
+    """The acceptance check against a REAL jitted engine: concurrent
+    identical requests from many threads cost one engine dispatch; the
+    engine call log is a counting wrapper around the live engine."""
+    from distributedmnist_tpu import models
+    from distributedmnist_tpu.parallel import make_mesh
+    from distributedmnist_tpu.serve import EngineFactory, ModelRegistry
+
+    factory = EngineFactory(models.build("mlp", platform="cpu"),
+                            make_mesh(eight_devices), max_batch=16)
+    router = factory.make_router()
+    registry = ModelRegistry(factory, router)
+    registry.add(factory.init_params(0), version="v1")
+    registry.promote("v1")
+    engine = registry.get("v1").engine
+    calls = []
+    real_dispatch = engine.dispatch
+    engine.dispatch = lambda xs: (calls.append(1),
+                                  real_dispatch(xs))[1]
+    m = ServeMetrics()
+    b = DynamicBatcher(router, max_wait_us=100_000, queue_depth=1024,
+                       metrics=m, dedup=True).start()
+    cache = PredictionCache(64)
+    front = CacheFront(b, router, cache, metrics=m)
+    try:
+        x = _rows(rng, 3)
+        futs = []
+        threads = [threading.Thread(
+            target=lambda: futs.append(front.submit(x)), daemon=True)
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        outs = [f.result(timeout=30) for f in futs]
+        assert len(outs) == 8
+        assert len({o.tobytes() for o in outs}) == 1
+        assert len(calls) == 1, (
+            f"{len(calls)} engine dispatches for 8 identical requests")
+        st = cache.stats()
+        assert st["hits"] + st["collapsed"] == 7
+    finally:
+        b.stop()
+
+
+def test_leader_failure_fails_followers_and_never_caches(rng):
+    """Leader error semantics: followers fail with the LEADER's error;
+    nothing is cached; the next identical request elects a fresh
+    leader and succeeds."""
+
+    class BreakableRouter(StubRouter):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.broken = True
+            self.release = threading.Event()
+
+        def dispatch(self, x):
+            if self.broken:
+                assert self.release.wait(timeout=10)
+                raise RuntimeError("engine down")
+            return super().dispatch(x)
+
+    eng = BreakableRouter(max_batch=16)
+    front, b, cache = _front(eng, dedup=False)
+    try:
+        x = _rows(rng, 2)
+        futs = [front.submit(x) for _ in range(4)]
+        time.sleep(0.05)          # let followers join the flight
+        eng.release.set()
+        for f in futs:
+            with pytest.raises(RuntimeError, match="engine down"):
+                f.result(timeout=10)
+        st = cache.stats()
+        assert st["entries"] == 0 and st["inserts"] == 0
+        assert st["inflight_keys"] == 0    # flight cleaned up
+        eng.broken = False
+        out = front.submit(x).result(timeout=10)   # fresh leader
+        assert out.shape == (2, 10)
+        assert cache.stats()["inserts"] == 1
+    finally:
+        b.stop()
+
+
+# -- invalidation races (the stale-hit guarantee) --------------------------
+
+
+def test_promote_mid_flight_drops_insert_but_resolves_followers(rng):
+    """A live-route change while a single-flight leader is in flight:
+    the followers still resolve (their requests were admitted under
+    the old route, like any in-flight batch across a promote), but the
+    computed bytes are NOT cached — the epoch bump at invalidation
+    refuses the insert, so no later lookup under a restored route can
+    see them."""
+    gate = threading.Event()
+    eng = StubRouter(max_batch=16, gate=gate)
+    front, b, cache = _front(eng)
+    try:
+        x = _rows(rng, 2)
+        futs = [front.submit(x) for _ in range(3)]
+        assert eng.in_call.wait(timeout=10)
+        # the promote lands while the leader computes
+        eng.set_live_route("v2")
+        cache.invalidate("promote v1 -> v2")
+        gate.set()
+        outs = [f.result(timeout=10) for f in futs]
+        assert len({o.tobytes() for o in outs}) == 1
+        st = cache.stats()
+        assert st["entries"] == 0, "stale insert survived a promote"
+        assert st["stale_drops"] >= 1
+        # a new identical request under v2 is a fresh miss computing v2
+        eng.gate = None
+        fresh_fut = front.submit(x)
+        fresh = fresh_fut.result(timeout=10)
+        assert fresh_fut.version == "v2"
+        assert fresh.tobytes() != outs[0].tobytes()   # v2-offset bytes
+    finally:
+        b.stop()
+
+
+def test_hammered_promotes_never_serve_stale_version_bytes(rng):
+    """The satellite race test: promote/rollback flapping concurrent
+    with lookups and in-flight leaders. Every response's BYTES must
+    match the version its future claims (StubRouter encodes the
+    computing version as a logit offset) — a stale-version hit would
+    show v1 bytes under a v2 tag or vice versa."""
+    eng = StubRouter(max_batch=16)
+    front, b, cache = _front(eng, capacity=256)
+    xs = [_rows(rng, 1) for _ in range(8)]
+    base = {x.tobytes(): x.reshape(1, -1)[:, :10].astype(np.float32)
+            for x in xs}
+    errors: list = []
+    stop = threading.Event()
+
+    def flipper():
+        v = 2
+        while not stop.is_set():
+            eng.set_live_route(f"v{v}")
+            cache.invalidate(f"flip to v{v}")
+            v = 3 - v              # v1 <-> v2
+            time.sleep(0.002)
+
+    def submitter(idx):
+        r = np.random.default_rng(idx)
+        for _ in range(60):
+            x = xs[int(r.integers(0, len(xs)))]
+            try:
+                fut = front.submit(x)
+                out = fut.result(timeout=10)
+            except Exception as e:          # noqa: BLE001
+                errors.append(f"submit died: {e!r}")
+                return
+            v = fut.version
+            expected = base[x.tobytes()] + StubRouter.OFFSETS[v]
+            if out.tobytes() != expected.astype(np.float32).tobytes():
+                errors.append(
+                    f"STALE HIT: bytes do not match claimed {v}")
+
+    flip = threading.Thread(target=flipper, daemon=True)
+    flip.start()
+    try:
+        threads = [threading.Thread(target=submitter, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+    finally:
+        stop.set()
+        flip.join(timeout=10)
+        b.stop()
+    assert not errors, errors[:5]
+
+
+def test_registry_route_changes_invalidate_cache(eight_devices, rng):
+    """The registry hook (ISSUE 10): promote, rollback and dtype
+    activation all invalidate the installed cache atomically with the
+    routing swap — seeded entries vanish on every live-route change,
+    and the epoch moves so racing inserts are refused."""
+    from distributedmnist_tpu import models
+    from distributedmnist_tpu.parallel import make_mesh
+    from distributedmnist_tpu.serve import EngineFactory, ModelRegistry
+
+    factory = EngineFactory(models.build("mlp", platform="cpu"),
+                            make_mesh(eight_devices), max_batch=16)
+    router = factory.make_router()
+    registry = ModelRegistry(factory, router)
+    cache = PredictionCache(capacity=8)
+    registry.set_cache(cache)
+    registry.add(factory.init_params(0), version="v1")
+    registry.add(factory.init_params(1), version="v2")
+
+    def seed_entry():
+        live, dtype = router.live_route()
+        key = content_key(live, dtype, _rows(rng, 1))
+        assert cache.insert(key, np.zeros((1, 10)), live, dtype,
+                            epoch=cache.epoch())
+
+    registry.promote("v1")
+    assert cache.stats()["invalidations"] == 1
+    seed_entry()
+    registry.promote("v2")                       # promote
+    assert cache.stats()["entries"] == 0
+    assert cache.stats()["invalidations"] == 2
+    seed_entry()
+    assert registry.rollback("v2", "test rollback") is not None
+    assert cache.stats()["entries"] == 0         # rollback
+    assert cache.stats()["invalidations"] == 3
+    # shadow/canary routing does NOT change the live route: no flush
+    registry.set_shadow("v2", 0.5)
+    assert cache.stats()["invalidations"] == 3
+
+
+# -- intra-batch dedup -----------------------------------------------------
+
+
+def test_intra_batch_dedup_dispatches_unique_rows_once(rng):
+    """Identical rows inside one coalesced drain dispatch once: the
+    drain [A, A, B] runs nA+nB rows (not 2*nA+nB), every future
+    resolves, and the riders' bytes equal their representative's."""
+    gate = threading.Event()
+    eng = StubRouter(max_batch=16, gate=gate)
+    m = ServeMetrics()
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=256,
+                       metrics=m, dedup=True).start()
+    try:
+        first = b.submit(_rows(rng, 1))    # occupies the window
+        assert eng.in_call.wait(timeout=10)
+        a = _rows(rng, 3)
+        bb = _rows(rng, 2)
+        fa1, fa2, fb = b.submit(a), b.submit(a.copy()), b.submit(bb)
+        gate.set()
+        first.result(timeout=10)
+        ra1 = fa1.result(timeout=10)
+        ra2 = fa2.result(timeout=10)
+        rb = fb.result(timeout=10)
+        assert ra1.tobytes() == ra2.tobytes()
+        assert rb.shape == (2, 10)
+        assert eng.calls == [1, 5], (
+            f"expected the dedup'd 5-row dispatch, got {eng.calls}")
+        snap = m.snapshot()
+        assert snap["dedup"] == {"requests": 1, "rows": 3}
+        assert snap["requests"] == 4       # riders are served requests
+    finally:
+        b.stop()
+
+
+def test_dedup_off_by_default_dispatches_every_row(rng):
+    gate = threading.Event()
+    eng = StubRouter(max_batch=16, gate=gate)
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=256).start()
+    try:
+        first = b.submit(_rows(rng, 1))
+        assert eng.in_call.wait(timeout=10)
+        a = _rows(rng, 3)
+        f1, f2 = b.submit(a), b.submit(a.copy())
+        gate.set()
+        first.result(timeout=10)
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+        assert eng.calls == [1, 6]         # no dedup: 3 + 3 rows
+    finally:
+        b.stop()
+
+
+def test_dedup_failure_fails_riders_with_same_error(rng):
+    class FailsSecond(StubRouter):
+        def dispatch(self, x):
+            if len(self.calls) >= 1:
+                self.calls.append(-1)
+                raise RuntimeError("poisoned drain")
+            return super().dispatch(x)
+
+    gate = threading.Event()
+    eng = FailsSecond(max_batch=16, gate=gate)
+    b = DynamicBatcher(eng, max_wait_us=50_000, queue_depth=256,
+                       dedup=True).start()
+    try:
+        first = b.submit(_rows(rng, 1))
+        assert eng.in_call.wait(timeout=10)
+        a = _rows(rng, 2)
+        f1, f2 = b.submit(a), b.submit(a.copy())
+        gate.set()
+        first.result(timeout=10)
+        for f in (f1, f2):
+            with pytest.raises(RuntimeError, match="poisoned drain"):
+                f.result(timeout=10)
+    finally:
+        b.stop()

@@ -172,6 +172,81 @@ def test_prometheus_gauges_and_label_escaping():
     assert r'version="v\"weird\\name"' in text
 
 
+def test_prometheus_every_series_carries_help(rng=None):
+    """ISSUE 10 satellite: every emitted dmnist_serve_* family gets a
+    `# HELP` line alongside its `# TYPE` line — scrapers and humans
+    both read the exposition. Checked structurally: each TYPE line must
+    be immediately preceded by a HELP line for the SAME name."""
+    from distributedmnist_tpu.serve import trace as trace_lib
+
+    tr = trace_lib.Tracer()
+    tr.add_span("queue.wait", 0.0, 0.001, rids=())
+    cache_stats = {"hits": 3, "hit_rows": 3, "misses": 1,
+                   "collapsed": 2, "inserts": 1, "evictions": 0,
+                   "invalidations": 1, "stale_drops": 0, "entries": 1,
+                   "inflight_keys": 0, "hit_ratio": 0.75,
+                   "capacity": 8, "epoch": 1}
+    text = prometheus_exposition(_sample_snapshot(),
+                                 trace_stages=tr.snapshot()["stages"],
+                                 gauges={"pending_rows": 2},
+                                 cache=cache_stats)
+    lines = text.splitlines()
+    typed = [(i, line.split()[2]) for i, line in enumerate(lines)
+             if line.startswith("# TYPE")]
+    assert typed, "no TYPE lines at all"
+    for i, name in typed:
+        assert i > 0 and lines[i - 1].startswith(f"# HELP {name} "), (
+            f"{name} has no # HELP line (line {i}: {lines[i - 1]!r})")
+        # the help text is prose, not an empty stub
+        assert len(lines[i - 1].split(None, 2)[2]) > 3, name
+
+
+def test_prometheus_cache_series():
+    """The ISSUE 10 counters + hit ratio flatten into stable
+    dmnist_serve_cache_* series from the PredictionCache.stats dict;
+    dedup counters come from the snapshot itself."""
+    m = ServeMetrics()
+    m.record_cache_hit(0.0001, rows=2, version="v1",
+                       infer_dtype="float32")
+    m.record_dedup(3, 9)
+    stats = {"hits": 5, "hit_rows": 10, "misses": 2, "collapsed": 1,
+             "inserts": 2, "evictions": 1, "invalidations": 4,
+             "stale_drops": 1, "entries": 2, "inflight_keys": 0,
+             "hit_ratio": 0.7143, "capacity": 8, "epoch": 4}
+    text = prometheus_exposition(m.snapshot(), cache=stats)
+    lines = text.splitlines()
+    assert "dmnist_serve_cache_hits_total 5" in lines
+    assert "dmnist_serve_cache_misses_total 2" in lines
+    assert "dmnist_serve_cache_collapsed_total 1" in lines
+    assert "dmnist_serve_cache_evictions_total 1" in lines
+    assert "dmnist_serve_cache_invalidations_total 4" in lines
+    assert "dmnist_serve_cache_stale_drops_total 1" in lines
+    assert "dmnist_serve_cache_hit_ratio 0.7143" in lines
+    assert "dmnist_serve_cache_entries 2" in lines
+    assert "dmnist_serve_dedup_requests_total 3" in lines
+    assert "dmnist_serve_dedup_rows_total 9" in lines
+    # without a cache installed the series are absent, never faked
+    text2 = prometheus_exposition(ServeMetrics().snapshot())
+    assert "dmnist_serve_cache_hits_total" not in text2
+
+
+def test_record_cache_hit_feeds_populations():
+    """A cache hit is a served request: global counters, per-version
+    and per-dtype populations all move (the observability satellite's
+    accounting half)."""
+    m = ServeMetrics()
+    m.record_cache_hit(0.0002, rows=3, version="v1",
+                       infer_dtype="int8")
+    m.record_cache_hit(0.0001, rows=1, version="v1", collapsed=True)
+    snap = m.snapshot()
+    assert snap["requests"] == 2 and snap["rows"] == 4
+    assert snap["by_version"]["v1"]["requests"] == 2
+    assert snap["by_dtype"]["int8"]["rows"] == 3
+    assert snap["cache_served"] == {"hit_requests": 1, "hit_rows": 4,
+                                    "collapsed_requests": 1}
+    assert snap["latency_ms"]["p99"] is not None
+
+
 def test_prometheus_stage_histogram_cumulates():
     """Span-derived stage histograms flatten with CUMULATIVE buckets
     (the Prometheus histogram contract), one series per stage."""
