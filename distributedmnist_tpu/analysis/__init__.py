@@ -1,18 +1,22 @@
-"""Concurrency sanitizer + project lint (ISSUE 8).
+"""Concurrency sanitizer, project lint, and deterministic model
+checker (ISSUEs 8 + 11).
 
-The serving stack is a ~4.8k-LoC concurrent system whose invariants —
+The serving stack is a ~5k-LoC concurrent system whose invariants —
 lock acquisition order, nothing slow under a hot-path lock, every
-staging buffer recycled, every in-flight slot released — were enforced
-by reviewer vigilance through PRs 3-7 (each needed multiple post-review
+staging buffer recycled, every in-flight slot released, no schedule in
+which a promote races a single-flight insert — were enforced by
+reviewer vigilance through PRs 3-7 (each needed multiple post-review
 hardening rounds for the same recurring bug classes). This package
 enforces them mechanically, on every tier-1 run:
 
-- locks.py     the named Lock/RLock/Condition/Semaphore/Thread factory
-               every serve/ module constructs its primitives through.
-               With no sanitizer installed the factories return the
-               bare threading primitives (zero wrappers, zero cost);
-               installed, they return instrumented wrappers feeding the
-               sanitizer.
+- locks.py     the named Lock/RLock/Condition/Semaphore/FIFO/Thread
+               factory every serve/ module constructs its primitives
+               through. With nothing installed the factories return
+               the bare threading primitives (zero wrappers, zero
+               cost); under a sanitizer they return instrumented
+               wrappers; under a model-checking Controller they return
+               shadow primitives whose every operation is a schedule
+               yield point.
 - sanitize.py  the runtime sanitizer: a global lock-order graph with
                cycle detection (potential deadlock), blocking-call-
                under-lock detection (time.sleep / socket I/O / the
@@ -21,16 +25,38 @@ enforces them mechanically, on every tier-1 run:
                in-flight window slots must net to zero at drain).
                Opt-in via install_sanitizer() or DMNIST_SANITIZE=1; a
                conftest fixture turns it on for every serve test.
+- explore.py   the deterministic schedule explorer (ISSUE 11): a
+               loom/CHESS-style controller that runs threads one-at-a-
+               time through the factory yield points under a chosen
+               schedule — seeded-random or bounded systematic DFS with
+               sleep-set partial-order reduction on independent
+               primitive names — so an interleaving bug is a
+               REPLAYABLE SEED, not a flake. `python -m
+               distributedmnist_tpu.analysis.explore` (tier-1 runs
+               --smoke; scripts/explore.sh the 500-schedule budget).
+- harnesses.py the four explored serve state machines (cache single-
+               flight vs promote epoch, registry promote/rollback/
+               eviction, batcher submit/shed/drain/stop, fleet pick/
+               failover/drain-rejoin) with their invariants, plus the
+               planted mutations the explorer must find (self-test).
+- report.py    ANALYSIS_r*.json round artifacts (BENCH-style
+               numbering) emitted by the explorer CLI and by
+               Sanitizer.assert_clean(artifact=...) — the analysis-
+               coverage trajectory.
 - lint.py      the AST project lint (`python -m
                distributedmnist_tpu.analysis`): codified rules from
                past review findings, each with a rule ID, a file:line
-               report, and a pragma allowlist. Exits nonzero on
-               findings — scripts/lint.sh wires it before pytest in
-               scripts/tier1.sh.
+               report, and a pragma allowlist — including the
+               dataflow-aware DML009 (future resolution reachable
+               under a serve lock, interprocedural), DML010 (lock-
+               containment inference) and DML011 (jit-cache-key
+               hazards). Exits nonzero on findings — scripts/lint.sh
+               wires it before pytest in scripts/tier1.sh.
 """
 
 from distributedmnist_tpu.analysis.locks import (make_condition,  # noqa: F401
-                                                 make_lock, make_rlock,
+                                                 make_fifo, make_lock,
+                                                 make_rlock,
                                                  make_semaphore,
                                                  make_thread)
 from distributedmnist_tpu.analysis.sanitize import (  # noqa: F401
@@ -39,7 +65,7 @@ from distributedmnist_tpu.analysis.sanitize import (  # noqa: F401
 
 __all__ = [
     "make_lock", "make_rlock", "make_condition", "make_semaphore",
-    "make_thread", "Sanitizer", "install_sanitizer",
+    "make_fifo", "make_thread", "Sanitizer", "install_sanitizer",
     "uninstall_sanitizer", "active_sanitizer", "blocking",
     "resource_acquire", "resource_release",
 ]

@@ -1,0 +1,1038 @@
+"""Deterministic concurrency model checker over the named-primitive
+substrate (ISSUE 11): loom/CHESS-style schedule exploration.
+
+The PR 8 sanitizer OBSERVES whatever schedules happen to run; every
+hard serving bug so far (fleet staging leak, mid-promote
+misattribution, shadow FIFO inflation, follower-skip races) was an
+*interleaving* bug ordinary tests catch only by luck. This module
+closes the gap: the same `analysis/locks.py` factories that name every
+serve primitive become the instrumentation points of a **controller**
+that runs threads one-at-a-time — every acquire/release/wait/notify/
+semaphore/FIFO op is a yield point, the controller picks which thread
+advances next, and the whole interleaving is a *replayable seed*
+instead of a flake.
+
+Mechanics (Controller):
+
+- Threads built through `make_thread` (and `Controller.spawn`) are real
+  OS threads gated per-step by an Event: exactly one runs at a time,
+  everyone else is parked at a yield point with a *ready predicate*
+  (lock free, semaphore > 0, FIFO non-empty, condition notified,
+  future done, thread finished). The scheduler loop computes the
+  enabled set, asks the policy for a choice, wakes it, and waits for it
+  to park again — so shadow primitive state is only ever mutated by the
+  single running thread and the enabled set is evaluated at quiescence.
+- Primitives built through `make_lock`/`make_rlock`/`make_condition`/
+  `make_semaphore`/`make_fifo` under an installed controller are pure
+  Python state machines (no real locking needed — one thread runs at a
+  time). Condition `wait(timeout)` models the timeout as "eligible to
+  wake at any schedule step" (spurious wakeup / expiry); untimed
+  `wait()` wakes only on notify — which is how lost-wakeup bugs become
+  *reachable deadlocks* instead of 0.1 s stalls.
+- `time.monotonic`/`time.perf_counter` are patched to a **logical
+  clock** that ticks once per scheduled step (and fast-forwards through
+  `time.sleep`), so coalesce windows and deadline math are
+  deterministic functions of the schedule, not the host.
+- An empty enabled set with live threads is reported as a **deadlock**
+  (each thread's pending op and target named); a thread blocking on an
+  uninstrumented primitive trips a real-time watchdog and is reported
+  as such — never a silent hang.
+
+Schedules (Explorer):
+
+- `RandomPolicy(seed)`: uniform choice among enabled threads — the
+  workhorse. One seed = one schedule; replaying the seed replays the
+  identical interleaving and the identical finding (asserted by the
+  replay-determinism test).
+- `DfsPolicy`: bounded systematic DFS over choice points with a
+  partial-order reduction on independent primitive *names* — when
+  every enabled thread's pending op targets a distinct primitive the
+  ops commute at the protocol level, so the step is executed without
+  branching; only conflicting steps (two threads about to touch the
+  same name) become DFS choice points. (Plain-field data races between
+  yield points are outside this model — the lint's DML010 containment
+  inference covers those statically.)
+
+Findings carry (machine, seed, step, detail, schedule trace); the
+harnesses in `analysis/harnesses.py` assert each machine's invariants
+across N explored schedules, and a planted-mutation self-test proves
+the explorer actually finds the bug classes it exists for.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import threading
+import time
+import traceback
+from collections import Counter, deque
+from typing import Callable, Optional
+
+# Real clocks, captured before any patching: the controller's own
+# machinery (watchdog, wall timing) must never read its logical clock.
+_REAL_MONOTONIC = time.monotonic
+_REAL_PERF = time.perf_counter
+_REAL_SLEEP = time.sleep
+
+
+class Killed(BaseException):
+    """Raised at yield points of still-parked threads when a run is
+    aborted (finding recorded, budget exhausted): unwinds the thread
+    through its finallys and out. BaseException so serve code's
+    `except Exception` failure paths cannot swallow it."""
+
+
+class InvariantViolation(AssertionError):
+    """A machine invariant failed under some schedule."""
+
+
+# The module-global active controller (None in production and in every
+# non-exploring test — the locks.py factories check it first).
+_active: Optional["Controller"] = None
+
+
+def active_controller() -> Optional["Controller"]:
+    return _active
+
+
+def _ctl_monotonic() -> float:
+    c = _active
+    return _REAL_MONOTONIC() if c is None else c.base + c.clock
+
+
+def _ctl_perf_counter() -> float:
+    c = _active
+    return _REAL_PERF() if c is None else c.base + c.clock
+
+
+def _ctl_sleep(seconds) -> None:
+    c = _active
+    if c is None:
+        return _REAL_SLEEP(seconds)
+    c.on_sleep(float(seconds))
+
+
+# -- tasks -----------------------------------------------------------------
+
+
+class _Task:
+    """One controlled thread's scheduling state."""
+
+    __slots__ = ("tid", "name", "thread", "state", "gate", "pending",
+                 "ready", "exc", "daemon")
+
+    def __init__(self, tid: int, name: str, thread, daemon: bool):
+        self.tid = tid
+        self.name = name
+        self.thread = thread
+        self.daemon = daemon
+        # "parked"   — at a yield point, waiting for a grant
+        # "running"  — granted, executing until its next yield/finish
+        # "finished" — run() returned (or unwound)
+        self.state = "parked"
+        self.gate = threading.Event()
+        self.pending = ("thread.start", name)
+        self.ready: Optional[Callable[[], bool]] = None
+        self.exc: Optional[BaseException] = None
+
+    def is_ready(self) -> bool:
+        if self.ready is None:
+            return True
+        return bool(self.ready())
+
+
+class _ControlledThread(threading.Thread):
+    """make_thread's product under an installed controller: a real
+    thread whose body is gated by the scheduler. join() is cooperative
+    (a yield point blocking on the target's completion) — a thread that
+    never finishes surfaces as a deadlock, not a silent timeout."""
+
+    def __init__(self, ctl: "Controller", target, name: str,
+                 daemon: bool, args: tuple, kwargs: dict):
+        super().__init__(name=name, daemon=daemon)
+        self._ctl = ctl
+        self._body = (target, args, kwargs)
+        self._task: Optional[_Task] = None
+
+    def start(self) -> None:
+        self._task = self._ctl._register(self.name, self, self.daemon)
+        super().start()
+
+    def run(self) -> None:
+        target, args, kwargs = self._body
+        self._ctl._run_task(self._task, target, args, kwargs)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        task = self._task
+        if task is None:
+            return
+        if self._ctl.current_task() is not None:
+            if timeout is None:
+                self._ctl.yield_point(
+                    "thread.join", task.name,
+                    ready=lambda: task.state == "finished")
+            else:
+                # Timed join models production faithfully: "the
+                # timeout may fire at any step", so schedules where
+                # stop() abandons a still-running thread are explored
+                # instead of mis-reported as deadlocks.
+                self._ctl.yield_point("thread.join", task.name)
+        if task.state == "finished":
+            super().join(timeout=2.0)
+
+
+# -- controlled primitives -------------------------------------------------
+
+
+class _CtlLock:
+    """Shadow mutex: ownership is plain state (only one thread runs at
+    a time), acquisition is a yield point gated on availability."""
+
+    def __init__(self, ctl: "Controller", name: str):
+        self._ctl = ctl
+        self.name = name
+        self._owner: Optional[_Task] = None
+        ctl._register_prim(name, self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ctl = self._ctl
+        if blocking and (timeout is None or timeout < 0):
+            ctl.yield_point("lock.acquire", self.name,
+                            ready=lambda: self._owner is None)
+        else:
+            # non-blocking / timed: eligible any step; may fail
+            ctl.yield_point("lock.tryacquire", self.name)
+            if self._owner is not None:
+                return False
+        self._owner = ctl.current_task()
+        return True
+
+    def release(self) -> None:
+        self._ctl.yield_point("lock.release", self.name)
+        self._owner = None
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _free(self) -> bool:
+        return self._owner is None
+
+    def __repr__(self) -> str:
+        return f"<CtlLock {self.name!r} owner={getattr(self._owner, 'name', None)!r}>"
+
+
+class _CtlRLock:
+    def __init__(self, ctl: "Controller", name: str):
+        self._ctl = ctl
+        self.name = name
+        self._owner: Optional[_Task] = None
+        self._depth = 0
+        ctl._register_prim(name, self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ctl = self._ctl
+        me = ctl.current_task()
+        ctl.yield_point(
+            "rlock.acquire", self.name,
+            ready=lambda: self._owner is None or self._owner is me)
+        self._owner = me
+        self._depth += 1
+        return True
+
+    def release(self) -> None:
+        self._ctl.yield_point("rlock.release", self.name)
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition-protocol helpers used by _CtlCondition (no yields of
+    # their own — the condition's wait sequences the yields).
+    def _release_all(self) -> int:
+        depth = self._depth
+        self._owner = None
+        self._depth = 0
+        return depth
+
+    def _restore(self, task: _Task, depth: int) -> None:
+        self._owner = task
+        self._depth = depth
+
+    def _free(self) -> bool:
+        return self._owner is None
+
+    def __repr__(self) -> str:
+        return f"<CtlRLock {self.name!r} depth={self._depth}>"
+
+
+class _CtlCondition:
+    """Shadow condition variable over a _CtlRLock (the same reentrant
+    semantics as production threading.Condition()). wait(timeout=None)
+    wakes only on notify; a timed wait is additionally eligible to wake
+    at any schedule step — the model of "the timeout may fire"."""
+
+    def __init__(self, ctl: "Controller", name: str):
+        self._ctl = ctl
+        self.name = name
+        self._lock = _CtlRLock(ctl, name)
+        self._waiters: list = []      # [task, {"notified": bool}]
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        return self._lock.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ctl = self._ctl
+        me = ctl.current_task()
+        if self._lock._owner is not me:
+            raise RuntimeError("cannot wait on un-acquired condition "
+                               f"{self.name!r}")
+        token = {"notified": False}
+        self._waiters.append((me, token))
+        depth = self._lock._release_all()
+        if timeout is None:
+            ctl.yield_point("cond.wait", self.name,
+                            ready=lambda: token["notified"])
+        else:
+            # timed wait: wake on notify OR at any step (expiry model);
+            # fast-forward the logical clock so wait-until-deadline
+            # loops converge
+            ctl.advance_clock(min(max(timeout, 0.0), 0.05))
+            ctl.yield_point("cond.timedwait", self.name)
+        try:
+            self._waiters.remove((me, token))
+        except ValueError:
+            pass
+        ctl.yield_point("cond.reacquire", self.name,
+                        ready=self._lock._free)
+        self._lock._restore(me, depth)
+        return token["notified"]
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        result = predicate()
+        while not result:
+            self.wait(timeout)
+            result = predicate()
+            if timeout is not None and not result:
+                break
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._ctl.yield_point("cond.notify", self.name)
+        # real Condition.notify removes waiters from its deque, so two
+        # notify(1) calls wake two DISTINCT waiters even before either
+        # gets scheduled — skip already-notified tokens to match
+        remaining = n
+        for _, token in self._waiters:
+            if remaining <= 0:
+                break
+            if not token["notified"]:
+                token["notified"] = True
+                remaining -= 1
+
+    def notify_all(self) -> None:
+        self._ctl.yield_point("cond.notify", self.name)
+        for _, token in self._waiters:
+            token["notified"] = True
+
+    def _free(self) -> bool:
+        return self._lock._free()
+
+    def __repr__(self) -> str:
+        return f"<CtlCondition {self.name!r} waiters={len(self._waiters)}>"
+
+
+class _CtlSemaphore:
+    """Shadow counting semaphore; the controller keeps a per-name net
+    acquire-release balance (the harnesses' window-balance-zero
+    invariant, mirroring the sanitizer's resource accounting)."""
+
+    def __init__(self, ctl: "Controller", name: str, value: int):
+        self._ctl = ctl
+        self.name = name
+        self._value = value
+        ctl._register_prim(name, self)
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        ctl = self._ctl
+        if blocking and timeout is None:
+            ctl.yield_point("sem.acquire", self.name,
+                            ready=lambda: self._value > 0)
+        else:
+            ctl.yield_point("sem.tryacquire", self.name)
+            if self._value <= 0:
+                return False
+        self._value -= 1
+        ctl.sem_balance[self.name] = ctl.sem_balance.get(self.name, 0) + 1
+        return True
+
+    def release(self, n: int = 1) -> None:
+        ctl = self._ctl
+        ctl.yield_point("sem.release", self.name)
+        self._value += n
+        ctl.sem_balance[self.name] = ctl.sem_balance.get(self.name, 0) - n
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<CtlSemaphore {self.name!r} value={self._value}>"
+
+
+class _CtlFifo:
+    """Shadow SimpleQueue (make_fifo): put never blocks, get parks on
+    non-empty — the batcher's dispatch->completion handle queue becomes
+    explorable instead of an uninstrumented real block."""
+
+    def __init__(self, ctl: "Controller", name: str):
+        self._ctl = ctl
+        self.name = name
+        self._q: deque = deque()
+
+    def put(self, item) -> None:
+        self._ctl.yield_point("fifo.put", self.name)
+        self._q.append(item)
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None):
+        ctl = self._ctl
+        if block and timeout is None:
+            ctl.yield_point("fifo.get", self.name,
+                            ready=lambda: len(self._q) > 0)
+        else:
+            ctl.yield_point("fifo.tryget", self.name)
+            if not self._q:
+                import queue as _queue
+
+                raise _queue.Empty
+        return self._q.popleft()
+
+    def empty(self) -> bool:
+        return not self._q
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+    def __repr__(self) -> str:
+        return f"<CtlFifo {self.name!r} depth={len(self._q)}>"
+
+
+# -- the controller --------------------------------------------------------
+
+
+class Controller:
+    """One schedule's cooperative scheduler: owns the tasks, the shadow
+    primitives, the logical clock, the schedule trace and the (single)
+    finding. Use via Explorer, or directly:
+
+        ctl = Controller(policy=RandomPolicy(seed))
+        ctl.explore(machine)        # machine.run(ctl) builds + drives
+        ctl.finding                 # None, or the recorded finding dict
+    """
+
+    def __init__(self, policy=None, max_steps: int = 20000,
+                 tick_s: float = 0.0005, watchdog_s: float = 20.0):
+        self.policy = policy if policy is not None else RandomPolicy(0)
+        self.max_steps = max_steps
+        self.tick_s = tick_s
+        self.watchdog_s = watchdog_s
+        self.base = 1000.0            # logical monotonic origin
+        self.clock = 0.0
+        self.steps = 0
+        self.trace: list = []         # (step, thread, op, target)
+        self.finding: Optional[dict] = None
+        self.completed = False        # every task ran to completion
+        self.pruned = False           # DFS sleep-set redundant prefix
+        self.aborted = False
+        self.sem_balance: dict[str, int] = {}
+        self.tasks: list[_Task] = []
+        self.prims: dict[str, list] = {}
+        self._tls = threading.local()
+        self._cv = threading.Condition()
+        self._tid = 0
+        self._names: Counter = Counter()
+        self._patched = False
+
+    # -- factory surface (locks.py delegates here) -------------------------
+
+    def new_lock(self, name: str) -> _CtlLock:
+        return _CtlLock(self, name)
+
+    def new_rlock(self, name: str) -> _CtlRLock:
+        return _CtlRLock(self, name)
+
+    def new_condition(self, name: str) -> _CtlCondition:
+        return _CtlCondition(self, name)
+
+    def new_semaphore(self, name: str, value: int) -> _CtlSemaphore:
+        return _CtlSemaphore(self, name, value)
+
+    def new_fifo(self, name: str) -> _CtlFifo:
+        return _CtlFifo(self, name)
+
+    def new_thread(self, target, name: str, daemon: bool,
+                   args: tuple = (), kwargs: Optional[dict] = None
+                   ) -> _ControlledThread:
+        return _ControlledThread(self, target, name, daemon, args,
+                                 kwargs or {})
+
+    def spawn(self, fn, name: str) -> _ControlledThread:
+        """Harness helper: start a controlled daemon thread."""
+        t = self.new_thread(fn, name=name, daemon=True)
+        t.start()
+        return t
+
+    # -- task plumbing ------------------------------------------------------
+
+    def current_task(self) -> Optional[_Task]:
+        return getattr(self._tls, "task", None)
+
+    def _register(self, name: str, thread, daemon: bool) -> _Task:
+        with self._cv:
+            self._names[name] += 1
+            if self._names[name] > 1:
+                name = f"{name}#{self._names[name]}"
+            self._tid += 1
+            task = _Task(self._tid, name, thread, daemon)
+            self.tasks.append(task)
+            self._cv.notify_all()
+        return task
+
+    def _register_prim(self, name: str, prim) -> None:
+        self.prims.setdefault(name, []).append(prim)
+
+    def _run_task(self, task: _Task, target, args, kwargs) -> None:
+        self._tls.task = task
+        try:
+            task.gate.wait()
+            task.gate.clear()
+            if not self.aborted:
+                target(*args, **kwargs)
+        except Killed:
+            pass
+        except BaseException as e:        # reported as a finding
+            task.exc = e
+        finally:
+            with self._cv:
+                task.state = "finished"
+                self._cv.notify_all()
+
+    def yield_point(self, kind: str, target: str,
+                    ready: Optional[Callable[[], bool]] = None) -> None:
+        """Park the calling controlled thread at a schedule point; the
+        op it is about to perform executes after the grant, atomically
+        up to its next yield. Uncontrolled threads fall through (their
+        op runs unscheduled — controlled primitives are meant to be
+        touched only by controlled threads)."""
+        task = self.current_task()
+        if task is None:
+            return
+        if self.aborted:
+            raise Killed()
+        with self._cv:
+            task.pending = (kind, target)
+            task.ready = ready
+            task.state = "parked"
+            self._cv.notify_all()
+        task.gate.wait()
+        task.gate.clear()
+        if self.aborted:
+            raise Killed()
+
+    def advance_clock(self, dt: float) -> None:
+        self.clock += max(dt, 0.0)
+
+    def on_sleep(self, seconds: float) -> None:
+        self.advance_clock(min(seconds, 0.05))
+        self.yield_point("sleep", f"{seconds:g}")
+
+    # -- queries (invariants run at quiescence) ----------------------------
+
+    def lock_free(self, name: str) -> bool:
+        """True when no instance of the named lock/rlock/condition is
+        held — the guard harness invariants use before reading state
+        the lock protects."""
+        return all(p._free() for p in self.prims.get(name, ())
+                   if hasattr(p, "_free"))
+
+    # -- time patching ------------------------------------------------------
+
+    def _patch_time(self) -> None:
+        time.monotonic = _ctl_monotonic
+        time.perf_counter = _ctl_perf_counter
+        time.sleep = _ctl_sleep
+        self._patched = True
+
+    def _unpatch_time(self) -> None:
+        if not self._patched:
+            return
+        # restore only what is still ours (the sanitizer's discipline)
+        if time.monotonic is _ctl_monotonic:
+            time.monotonic = _REAL_MONOTONIC
+        if time.perf_counter is _ctl_perf_counter:
+            time.perf_counter = _REAL_PERF
+        if time.sleep is _ctl_sleep:
+            time.sleep = _REAL_SLEEP
+        self._patched = False
+
+    # -- findings -----------------------------------------------------------
+
+    def _record(self, kind: str, detail: str) -> None:
+        if self.finding is None:
+            self.finding = {
+                "kind": kind,
+                "step": self.steps,
+                "detail": detail,
+                "trace_tail": [" ".join(map(str, t))
+                               for t in self.trace[-40:]],
+            }
+
+    # -- the scheduler loop -------------------------------------------------
+
+    def explore(self, machine) -> "Controller":
+        """Run one schedule of `machine` (an object with .run(ctl) and
+        optional .invariant(ctl)/.final(ctl)). Returns self; the
+        outcome is in .finding / .completed / .trace."""
+        global _active
+        if _active is not None:
+            raise RuntimeError("a Controller is already installed")
+        _active = self
+        self._patch_time()
+        # The machines deliberately drive failure paths thousands of
+        # times (failover rescues, registry refusals): the serve
+        # logger's per-event lines would dominate the run's wall time
+        # and drown the explorer's own report.
+        import logging
+
+        serve_log = logging.getLogger("distributedmnist_tpu")
+        prev_level = serve_log.level
+        serve_log.setLevel(logging.CRITICAL)
+        try:
+            root = self.new_thread(lambda: machine.run(self),
+                                   name="root", daemon=True)
+            root.start()
+            self._loop(machine)
+        finally:
+            self._shutdown()
+            self._unpatch_time()
+            serve_log.setLevel(prev_level)
+            _active = None
+        if self.finding is None:
+            for task in self.tasks:
+                if task.exc is not None:
+                    tb = "".join(traceback.format_exception(
+                        type(task.exc), task.exc,
+                        task.exc.__traceback__)).strip()
+                    self._record(
+                        "exception",
+                        f"thread {task.name!r} died: {tb.splitlines()[-1]}"
+                        f"\n{tb}")
+                    break
+        if self.finding is None and self.completed:
+            final = getattr(machine, "final", None)
+            if callable(final):
+                try:
+                    final(self)
+                except AssertionError as e:
+                    self._record("invariant", f"final check: {e}")
+        return self
+
+    def _loop(self, machine) -> None:
+        invariant = getattr(machine, "invariant", None)
+        while True:
+            granted_at = _REAL_MONOTONIC()
+            with self._cv:
+                while any(t.state == "running" for t in self.tasks):
+                    if not self._cv.wait(timeout=0.5):
+                        if _REAL_MONOTONIC() - granted_at > self.watchdog_s:
+                            stuck = [t.name for t in self.tasks
+                                     if t.state == "running"]
+                            self._record(
+                                "uninstrumented",
+                                f"thread(s) {stuck} blocked outside the "
+                                "controlled primitives (real lock/IO "
+                                "under exploration?) — watchdog fired")
+                            return
+                parked = [t for t in self.tasks if t.state == "parked"]
+                if not parked:
+                    self.completed = True
+                    return
+            # quiescent: run the machine invariant, compute enablement
+            if callable(invariant):
+                try:
+                    invariant(self)
+                except AssertionError as e:
+                    self._record("invariant", str(e))
+                    return
+            enabled = [t for t in parked if t.is_ready()]
+            if not enabled:
+                lines = [f"  {t.name}: waiting on {t.pending[0]} "
+                         f"{t.pending[1]!r}" for t in parked]
+                self._record(
+                    "deadlock",
+                    "no thread can make progress:\n" + "\n".join(lines))
+                return
+            if self.steps >= self.max_steps:
+                self._record(
+                    "budget",
+                    f"step budget {self.max_steps} exhausted with "
+                    f"{len(parked)} thread(s) still live")
+                return
+            enabled.sort(key=lambda t: t.tid)
+            choice = self.policy.choose(self, enabled)
+            if choice is None:
+                # DFS sleep sets: this prefix only commutes independent
+                # ops of an already-explored schedule — prune it.
+                self.pruned = True
+                return
+            self.steps += 1
+            self.clock += self.tick_s
+            self.trace.append((self.steps, choice.name, *choice.pending))
+            with self._cv:
+                choice.state = "running"
+            choice.gate.set()
+
+    def _shutdown(self) -> None:
+        """Release every still-parked thread with Killed and reap."""
+        self.aborted = True
+        with self._cv:
+            live = [t for t in self.tasks if t.state != "finished"]
+            for t in live:
+                t.gate.set()
+        deadline = _REAL_MONOTONIC() + 5.0
+        for t in live:
+            # bypass _ControlledThread.join — reaping must really wait
+            # for the Killed unwind, not model a timeout
+            threading.Thread.join(
+                t.thread, timeout=max(deadline - _REAL_MONOTONIC(), 0.1))
+
+
+# -- schedule policies -----------------------------------------------------
+
+
+class RandomPolicy:
+    """Seeded uniform choice among enabled threads: one seed, one
+    schedule, deterministically replayable."""
+
+    def __init__(self, seed: int):
+        import random
+
+        self.seed = seed
+        self._rng = random.Random(seed)
+
+    def choose(self, ctl: Controller, enabled: list) -> _Task:
+        return enabled[self._rng.randrange(len(enabled))]
+
+
+def _ops_independent(a: tuple, b: tuple) -> bool:
+    """Name-based independence: two pending ops commute iff they
+    target distinct primitives (lock/sem/FIFO/condition names, thread
+    names for start/join). Conservative — same name is always treated
+    as dependent."""
+    return a[1] != b[1]
+
+
+class DfsPolicy:
+    """Bounded systematic DFS with SLEEP-SET partial-order reduction
+    on independent primitive names (Godefroid's sleep sets: after a
+    subtree is explored via op `o`, sibling subtrees keep `o` asleep
+    until some DEPENDENT op — same primitive name — executes, so
+    schedules that merely commute independent ops are explored once).
+    Sleep sets preserve every deadlock and every terminal state; the
+    per-step quiescent invariants additionally run on every explored
+    schedule. Branching is at EVERY step with >= 2 awake threads —
+    the reduction prunes the tree, it never starves an interleaving
+    (the flaw a naive run-the-first-enabled reduction has).
+
+    Persistent across schedules — Explorer drives begin_schedule()/
+    end_schedule() and stops when .exhausted. choose() returns None
+    when every enabled thread is asleep: the schedule prefix is
+    redundant with an already-explored one and the controller prunes
+    the run."""
+
+    def __init__(self, por: bool = True):
+        self.por = por
+        self.exhausted = False
+        # One node per choice depth along the current DFS path:
+        # {"ops": [(name, op)] awake options, "chosen": int,
+        #  "explored": [op]} — explored ops join the sleep set of
+        # later siblings.
+        self._stack: list = []
+        self._depth = 0
+        self._sleep: set = set()      # ops asleep at the current step
+
+    def begin_schedule(self) -> None:
+        self._depth = 0
+        self._sleep = set()
+
+    def choose(self, ctl: Controller, enabled: list) -> Optional[_Task]:
+        by_name = {t.name: t for t in enabled}
+        pend = {t.name: (t.pending[0], t.pending[1]) for t in enabled}
+        if self.por:
+            awake = [t for t in enabled
+                     if (t.name, pend[t.name]) not in self._sleep]
+        else:
+            awake = list(enabled)
+        if not awake:
+            return None               # redundant prefix: prune
+        if len(awake) == 1:
+            chosen = awake[0]
+        else:
+            ops = [(t.name, pend[t.name]) for t in awake]
+            if self._depth < len(self._stack):
+                node = self._stack[self._depth]
+                if node["ops"] != ops:
+                    # enabled-set drift between replays would make the
+                    # whole DFS meaningless — fail loudly
+                    raise RuntimeError(
+                        "DFS replay divergence: enabled set changed "
+                        f"at depth {self._depth}: {node['ops']} vs "
+                        f"{ops}")
+            else:
+                node = {"ops": ops, "chosen": 0, "explored": []}
+                self._stack.append(node)
+            self._depth += 1
+            chosen = by_name[node["ops"][node["chosen"]][0]]
+            # sleep-set propagation into the child: previously explored
+            # siblings fall asleep; anything dependent on the chosen op
+            # wakes up
+            chosen_op = (chosen.name, pend[chosen.name])
+            carried = self._sleep | {
+                (nm, op) for nm, op in node["explored"]}
+            self._sleep = {
+                s for s in carried
+                if _ops_independent(s[1], chosen_op[1])
+                and s[0] != chosen.name}
+            return chosen
+        chosen_op = (chosen.name, pend[chosen.name])
+        self._sleep = {
+            s for s in self._sleep
+            if _ops_independent(s[1], chosen_op[1])
+            and s[0] != chosen.name}
+        return chosen
+
+    def end_schedule(self) -> None:
+        while self._stack:
+            node = self._stack[-1]
+            if node["chosen"] + 1 < len(node["ops"]):
+                node["explored"].append(node["ops"][node["chosen"]])
+                node["chosen"] += 1
+                return
+            self._stack.pop()
+        self.exhausted = True
+
+
+# -- the explorer ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MachineReport:
+    """One machine's exploration summary — the ANALYSIS artifact row."""
+
+    machine: str
+    schedules: int = 0
+    completed: int = 0
+    pruned: int = 0
+    budget_exhausted: int = 0
+    steps_total: int = 0
+    wall_s: float = 0.0
+    base_seed: int = 0
+    findings: list = dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Explorer:
+    """Drives N schedules of one machine factory and aggregates the
+    findings. `factory()` must return a FRESH machine per schedule
+    (state is rebuilt inside the controlled world every run)."""
+
+    def __init__(self, max_steps: int = 20000,
+                 stop_on_finding: bool = False):
+        self.max_steps = max_steps
+        self.stop_on_finding = stop_on_finding
+
+    def run_one(self, factory, seed: int) -> Controller:
+        ctl = Controller(policy=RandomPolicy(seed),
+                         max_steps=self.max_steps)
+        return ctl.explore(factory())
+
+    def run(self, factory, name: str, schedules: int,
+            base_seed: int = 0, policy: str = "random") -> MachineReport:
+        report = MachineReport(machine=name, base_seed=base_seed)
+        t0 = _REAL_MONOTONIC()
+        dfs = DfsPolicy() if policy == "dfs" else None
+        for i in range(schedules):
+            if dfs is not None and dfs.exhausted:
+                break
+            seed = base_seed + i
+            if dfs is not None:
+                dfs.begin_schedule()
+                ctl = Controller(policy=dfs, max_steps=self.max_steps)
+                ctl.explore(factory())
+                dfs.end_schedule()
+            else:
+                ctl = self.run_one(factory, seed)
+            report.schedules += 1
+            report.steps_total += ctl.steps
+            if ctl.completed:
+                report.completed += 1
+            if ctl.pruned:
+                report.pruned += 1
+            if ctl.finding is not None:
+                f = dict(ctl.finding)
+                f["machine"] = name
+                f["seed"] = seed
+                f["policy"] = policy
+                if f["kind"] == "budget":
+                    report.budget_exhausted += 1
+                else:
+                    report.findings.append(f)
+                    if self.stop_on_finding:
+                        break
+        report.wall_s = round(_REAL_MONOTONIC() - t0, 3)
+        return report
+
+
+def replay(factory, seed: int, max_steps: int = 20000) -> Controller:
+    """Re-run the exact schedule a seed produced: same policy choices,
+    same logical clock, same interleaving — the finding a failing seed
+    reported reproduces identically (the replay-determinism test pins
+    this)."""
+    return Explorer(max_steps=max_steps).run_one(factory, seed)
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedmnist_tpu.analysis.explore",
+        description="Deterministic schedule explorer over the four "
+                    "riskiest serve state machines (cache single-flight "
+                    "vs promote epoch, registry promote/rollback/"
+                    "eviction, batcher submit/shed/drain/stop, fleet "
+                    "pick/failover/drain-rejoin). Exit 0 clean, 1 on "
+                    "findings.")
+    p.add_argument("--machines",
+                   default="cache,registry,batcher,batcher-nodrain,"
+                           "fleet",
+                   help="comma-separated machine names (default: all)")
+    p.add_argument("--schedules", type=int, default=500,
+                   help="schedules per machine (default 500 — the "
+                       "scripts/explore.sh long budget)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="base seed; schedule i runs seed+i")
+    p.add_argument("--max-steps", type=int, default=20000)
+    p.add_argument("--policy", choices=("random", "dfs"),
+                   default="random")
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 preset: fixed seeds, a small bounded "
+                        "budget per machine (<= 30 s total)")
+    p.add_argument("--emit", action="store_true",
+                   help="write an ANALYSIS_r*.json artifact (BENCH-"
+                        "style round numbering)")
+    p.add_argument("--stop-on-finding", action="store_true")
+    args = p.parse_args(argv)
+
+    from distributedmnist_tpu.analysis import harnesses
+
+    if args.smoke:
+        args.schedules = min(args.schedules, harnesses.SMOKE_SCHEDULES)
+    names = [m.strip() for m in args.machines.split(",") if m.strip()]
+    unknown = [m for m in names if m not in harnesses.MACHINES]
+    if unknown:
+        print(f"explore: unknown machine(s) {unknown}; known: "
+              f"{sorted(harnesses.MACHINES)}", file=sys.stderr)
+        return 2
+    ex = Explorer(max_steps=args.max_steps,
+                  stop_on_finding=args.stop_on_finding)
+    reports = []
+    for name in names:
+        rep = ex.run(harnesses.MACHINES[name], name,
+                     schedules=args.schedules, base_seed=args.seed,
+                     policy=args.policy)
+        reports.append(rep)
+        status = ("CLEAN" if not rep.findings else
+                  f"{len(rep.findings)} FINDING(S)")
+        budget = (f", {rep.budget_exhausted} budget-exhausted"
+                  if rep.budget_exhausted else "")
+        print(f"explore: {name:<9} {rep.schedules} schedules "
+              f"({rep.completed} completed{budget}, "
+              f"{rep.steps_total} steps, {rep.wall_s:.1f}s) — {status}",
+              flush=True)
+        for f in rep.findings:
+            print(f"  [{f['kind']}] seed={f['seed']} step={f['step']}: "
+                  f"{f['detail'].splitlines()[0]}")
+            if args.policy == "dfs":
+                # DFS schedules are driven by the DFS stack, not the
+                # seed: replay by re-running the deterministic DFS
+                # sequence up to (and including) the failing schedule.
+                nth = f["seed"] - args.seed + 1
+                print(f"    replay: python -m distributedmnist_tpu"
+                      f".analysis.explore --machines {name} "
+                      f"--policy dfs --schedules {nth} "
+                      "--stop-on-finding")
+            else:
+                print(f"    replay: python -m distributedmnist_tpu"
+                      f".analysis.explore --machines {name} "
+                      f"--schedules 1 --seed {f['seed']}")
+    total_findings = sum(len(r.findings) for r in reports)
+    # A machine whose every schedule blew the step budget proved
+    # NOTHING — that must never read as a clean gate.
+    no_coverage = [r.machine for r in reports
+                   if r.schedules and r.completed == 0]
+    if no_coverage:
+        print(f"explore: machine(s) {no_coverage} completed ZERO "
+              "schedules (step budget exhausted?) — no coverage, "
+              "failing the gate", file=sys.stderr)
+    if args.emit:
+        from distributedmnist_tpu.analysis import report as report_mod
+
+        path = report_mod.emit_analysis({
+            "kind": "explorer",
+            "policy": args.policy,
+            "base_seed": args.seed,
+            "schedules_per_machine": args.schedules,
+            "machines": [r.as_dict() for r in reports],
+            "total_findings": total_findings,
+        })
+        print(f"explore: artifact written to {path}")
+    return 1 if (total_findings or no_coverage) else 0
+
+
+if __name__ == "__main__":
+    # runpy executes this file under the name "__main__": delegate to
+    # the CANONICAL module object so there is exactly one `_active`
+    # controller global — the one the locks.py factories read. Running
+    # the __main__ copy's main() directly would install the controller
+    # in a parallel module and hand every machine bare primitives.
+    from distributedmnist_tpu.analysis import explore as _canonical
+
+    sys.exit(_canonical.main())

@@ -1,0 +1,711 @@
+"""Model-checking harnesses for the four riskiest serve state machines
+(ISSUE 11), driven by `analysis/explore.py`.
+
+Each machine is a small closed world: the REAL serve component under
+test (PredictionCache + CacheFront, ModelRegistry + Router,
+DynamicBatcher, ReplicaSet) over pure-Python fakes for everything
+below it (no device work, no jit — schedules must be cheap and
+deterministic), plus client/admin threads that drive the racy
+operations and invariants checked at every quiescent step and at
+drain. Every primitive the real components build goes through the
+`analysis/locks.py` factories, so under an installed Controller the
+whole machine is explorable with zero changes to the code under test.
+
+The invariants are the machines' documented contracts:
+
+- **cache**: no stale bytes surface (every cache entry's payload was
+  computed in the route era that is still current for its version — an
+  insert that crossed an invalidation must have been refused), no
+  future left unresolved, the single-flight table empty at drain, and
+  every resolved result's payload matches its future's version tag.
+- **registry**: at quiescence exactly one version is 'live' and it is
+  the router's live target; no routed version is ever evicted.
+- **batcher**: every accepted future resolves, pending rows and the
+  in-flight gauge return to zero, and the window semaphore's net
+  acquire-release balance is zero at drain.
+- **fleet**: no mixed-version pick window (all replicas agree on the
+  live version whenever the pick lock is free), per-replica windows
+  and outstanding cost return to zero, and replica faults are absorbed
+  by failover (clients see results, not errors).
+
+Planted mutations (the explorer's self-test — an explorer that cannot
+find planted bugs is theater):
+
+- ``mutation="skip-follower"`` drops the first single-flight follower
+  registration (the ISSUE 10 "can a follower be skipped?" race, made
+  real): the skipped follower's future never resolves, which the
+  explorer reports as a deadlock/unresolved-future finding.
+- ``mutation="drop-epoch-bump"`` makes invalidation clear entries
+  without bumping the epoch: a leader that raced a promote/rollback
+  pair lands stale bytes in the cache, violating the era invariant.
+"""
+
+from __future__ import annotations
+
+import itertools
+import types
+from concurrent.futures import Future
+
+import numpy as np
+
+from distributedmnist_tpu.analysis.locks import make_fifo, make_lock
+
+# The tier-1 smoke budget (scripts/tier1.sh runs the CLI with --smoke):
+# fixed seeds, this many schedules per machine — small enough to stay
+# well under 30 s total, large enough to cross every interesting
+# interleaving class at least once per run.
+SMOKE_SCHEDULES = 25
+
+
+def await_future(ctl, fut: Future, what: str = "future") -> None:
+    """Cooperative future wait: a controller yield point parked on
+    fut.done() — never fut.result() on an unresolved future, which
+    would block the real thread outside the controller's model."""
+    ctl.yield_point("future.wait", what, ready=fut.done)
+
+
+def encode(version: str, era: int, rows: int) -> np.ndarray:
+    """Version+era-stamped payload: logits whose every element encodes
+    (version, route era) so stale bytes are OBSERVABLE, not just
+    theorized — the harness twin of the bench's version-encoded-logits
+    trick."""
+    code = float(int(version.lstrip("v")) * 1000 + era)
+    return np.full((rows, 10), code, dtype=np.float32)
+
+
+def decode(arr: np.ndarray) -> tuple:
+    code = int(arr.flat[0])
+    return (f"v{code // 1000}", code % 1000)
+
+
+# -- machine 1: cache single-flight vs promote/invalidation epoch ----------
+
+
+class _Route:
+    """Fake live route + promote: the (version, era) pair the cache
+    keys on, mutated atomically with the cache invalidation under one
+    state lock — the registry's `_route_set("live", ...)` shape. `era`
+    increments on every promote (including re-promotes of an old
+    version), so payload bytes can prove WHICH reign computed them."""
+
+    def __init__(self):
+        self._state_lock = make_lock("harness.route.state")
+        self.version = "v1"
+        self.era = 1
+        self.era_of = {"v1": 1}
+
+    @staticmethod
+    def _as_images(x) -> np.ndarray:
+        return np.asarray(x, dtype=np.uint8)
+
+    def live_route(self) -> tuple:
+        with self._state_lock:
+            return (self.version, None)
+
+    def live_version(self):
+        with self._state_lock:
+            return self.version
+
+    def promote(self, version: str, cache) -> None:
+        with self._state_lock:
+            self.era += 1
+            self.version = version
+            self.era_of[version] = self.era
+            cache.invalidate(reason=f"live -> {version}")
+
+
+class _RouteBatcher:
+    """Fake batcher under the CacheFront: submit() enqueues, a worker
+    thread captures the live route (the engine-capture-at-dispatch
+    model) and resolves the future — whose done-callback then runs the
+    REAL single-flight completion inline, exactly like the production
+    completion thread."""
+
+    def __init__(self, route: _Route):
+        self.route = route
+        self._rid = itertools.count(1)
+        self._q = make_fifo("harness.batcher.q")
+
+    def next_rid(self) -> int:
+        return next(self._rid)
+
+    def submit(self, x, deadline_s=None, key=None) -> Future:
+        fut: Future = Future()
+        fut.trace_id = None
+        self._q.put((fut, int(x.shape[0])))
+        return fut
+
+    def worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, rows = item
+            with self.route._state_lock:
+                v, era = self.route.version, self.route.era
+            fut.version = v
+            fut.set_result(encode(v, era, rows))
+
+
+class _DropFirstAppend(list):
+    """The skip-follower mutation's followers list: silently drops the
+    first registration."""
+
+    def __init__(self):
+        super().__init__()
+        self._dropped = False
+
+    def append(self, item) -> None:
+        if not self._dropped:
+            self._dropped = True
+            return
+        super().append(item)
+
+
+def _broken_invalidate(self, reason=None) -> None:
+    """The drop-epoch-bump mutation: entries cleared, epoch NOT bumped
+    — an in-flight single-flight insert that raced the invalidation now
+    lands instead of being refused."""
+    with self._lock:
+        self._entries.clear()
+        self._invalidations += 1
+
+
+class CacheMachine:
+    """Single-flight collapse vs promote/rollback invalidation epoch:
+    3 clients hammer two key classes while a promoter rolls
+    v1 -> v2 -> v1 (each roll invalidating atomically with the route
+    swap) and a worker thread resolves leaders."""
+
+    name = "cache"
+
+    def __init__(self, mutation: str = None):
+        self.mutation = mutation
+        self.futs: list = []
+        self.route = None
+        self.cache = None
+
+    def run(self, ctl) -> None:
+        from distributedmnist_tpu.serve import cache as cache_mod
+
+        restore = None
+        if self.mutation == "skip-follower":
+            real = cache_mod._Flight
+
+            def broken_flight(key, version, infer_dtype, epoch):
+                fl = real(key, version, infer_dtype, epoch)
+                fl.followers = _DropFirstAppend()
+                return fl
+
+            cache_mod._Flight = broken_flight
+            restore = real
+        try:
+            self._run(ctl, cache_mod)
+        finally:
+            if restore is not None:
+                cache_mod._Flight = restore
+
+    def _run(self, ctl, cache_mod) -> None:
+        self.route = route = _Route()
+        self.cache = cache = cache_mod.PredictionCache(capacity=8)
+        if self.mutation == "drop-epoch-bump":
+            cache.invalidate = types.MethodType(_broken_invalidate,
+                                                cache)
+        batcher = _RouteBatcher(route)
+        front = cache_mod.CacheFront(batcher, route, cache)
+        hot = np.zeros((2, 4), np.uint8)      # shared hot key
+        cold = np.ones((2, 4), np.uint8)
+
+        def client(payload, k):
+            def body():
+                for _ in range(k):
+                    self.futs.append(front.submit(payload))
+            return body
+
+        def promoter():
+            route.promote("v2", cache)
+            route.promote("v1", cache)
+
+        threads = [
+            ctl.spawn(client(hot, 2), "client-a"),
+            ctl.spawn(client(hot, 2), "client-b"),
+            ctl.spawn(client(cold, 1), "client-c"),
+            ctl.spawn(promoter, "promoter"),
+        ]
+        worker = ctl.spawn(batcher.worker, "worker")
+        for t in threads:
+            t.join()
+        for fut in list(self.futs):
+            await_future(ctl, fut, "client-result")
+        batcher._q.put(None)
+        worker.join()
+
+    def invariant(self, ctl) -> None:
+        cache, route = self.cache, self.route
+        if cache is None or route is None:
+            return
+        if not (ctl.lock_free("cache.state")
+                and ctl.lock_free("harness.route.state")):
+            return
+        for key, entry in list(cache._entries.items()):
+            assert (entry.version == key[0]
+                    and entry.infer_dtype == key[1]), (
+                f"cache entry/key identity mismatch: entry "
+                f"({entry.version}, {entry.infer_dtype}) under key "
+                f"({key[0]}, {key[1]})")
+            v, era = decode(entry.logits)
+            assert v == entry.version, (
+                f"cache entry for {entry.version} holds bytes computed "
+                f"by {v} — mixed-version bytes surfaced")
+            current = route.era_of.get(v)
+            assert era == current, (
+                f"stale bytes cached: entry for {v} carries era {era} "
+                f"but the route's current era for {v} is {current} — "
+                "an insert crossed an invalidation (epoch bump "
+                "dropped?)")
+
+    def final(self, ctl) -> None:
+        unresolved = [f for f in self.futs if not f.done()]
+        assert not unresolved, (
+            f"{len(unresolved)} submitted future(s) never resolved "
+            "(skipped single-flight follower?)")
+        assert not self.cache._flights, (
+            "single-flight table not empty at drain: "
+            f"{list(self.cache._flights)}")
+        for fut in self.futs:
+            v, era = decode(fut.result())
+            assert v == fut.version, (
+                f"result bytes from {v} tagged version {fut.version} — "
+                "mixed-version response")
+        self.invariant(ctl)
+
+
+# -- machine 2: registry promote/rollback vs concurrent admin + eviction ---
+
+
+class _RegEngine:
+    """A warmed engine by fiat: compiles nothing, prices nothing —
+    the registry's state machine is the subject, not XLA."""
+
+    def __init__(self, version: str, infer_dtype: str = "float32"):
+        self.version = version
+        self.infer_dtype = infer_dtype
+        self.max_batch = 8
+        self.buckets = (8,)
+        self.params = None
+
+    def warmup(self, cost_samples: int = 5) -> int:
+        return 0
+
+    def bucket_costs(self) -> dict:
+        return {}
+
+    def bucket_costs_p95(self) -> dict:
+        return {}
+
+    def infer(self, x) -> np.ndarray:
+        return np.zeros((np.asarray(x).shape[0], 10), np.float32)
+
+
+class _RegFactory:
+    max_batch = 8
+    buckets = (8,)
+
+    def make_engine(self, params, version, replica: int = 0,
+                    infer_dtype: str = "float32") -> _RegEngine:
+        return _RegEngine(version, infer_dtype)
+
+
+class RegistryMachine:
+    """Real ModelRegistry + real Router over fiat-warmed engines:
+    concurrent add/promote/canary/rollback/describe with eviction
+    pressure (max_versions=3). The contract: one live version, the
+    router always points at a resident one, routed versions are never
+    evicted."""
+
+    name = "registry"
+
+    def __init__(self):
+        self.reg = None
+        self.router = None
+
+    def run(self, ctl) -> None:
+        from distributedmnist_tpu.serve.registry import ModelRegistry
+        from distributedmnist_tpu.serve.router import Router
+
+        self.router = router = Router(max_batch=8, buckets=(8,),
+                                      platform="cpu")
+        self.reg = reg = ModelRegistry(_RegFactory(), router,
+                                       max_versions=3)
+        reg.add(None, version="v1")
+        reg.promote("v1")
+        expected = (KeyError, RuntimeError, ValueError)
+
+        def admin_a():
+            try:
+                reg.add(None, version="v2")
+                reg.promote("v2")
+            except expected:
+                pass
+
+        def admin_b():
+            try:
+                reg.add(None, version="v3")
+                reg.set_canary("v3", fraction=0.2)
+            except expected:
+                pass
+            reg.clear_candidates()
+
+        def evictor():
+            try:
+                reg.add(None, version="v4")
+            except expected:
+                pass
+
+        def roller():
+            reg.rollback("v2", reason="model-checker drill")
+
+        def reader():
+            for _ in range(3):
+                reg.describe()
+                reg.live_version()
+
+        threads = [ctl.spawn(admin_a, "admin-a"),
+                   ctl.spawn(admin_b, "admin-b"),
+                   ctl.spawn(evictor, "evictor"),
+                   ctl.spawn(roller, "roller"),
+                   ctl.spawn(reader, "reader")]
+        for t in threads:
+            t.join()
+
+    def invariant(self, ctl) -> None:
+        reg, router = self.reg, self.router
+        if reg is None or router is None:
+            return
+        if not (ctl.lock_free("registry.admin")
+                and ctl.lock_free("registry.state")
+                and ctl.lock_free("router.routes")):
+            return
+        # Quiescent reads go straight at the state (the controller
+        # thread is not a controlled task; taking the shadow locks from
+        # here would corrupt their ownership model).
+        versions = dict(reg._versions)
+        live = [name for name, mv in versions.items()
+                if mv.state == "live"]
+        assert len(live) <= 1, f"multiple live versions: {live}"
+        live_t = router._live
+        if live_t is not None:
+            mv = versions.get(live_t.version)
+            assert mv is not None, (
+                f"router live target {live_t.version!r} was evicted "
+                "from the registry")
+            assert mv.state == "live", (
+                f"router serves {live_t.version!r} but registry marks "
+                f"it {mv.state!r}")
+        in_route = {t.version for t in (router._live, router._canary,
+                                        router._shadow)
+                    if t is not None}
+        missing = in_route - set(versions)
+        assert not missing, (
+            f"routed version(s) {sorted(missing)} evicted while still "
+            "in the routing table")
+
+    def final(self, ctl) -> None:
+        assert self.router._live is not None, "no live version at drain"
+        self.invariant(ctl)
+
+
+# -- machine 3: batcher submit/shed/drain vs stop --------------------------
+
+
+class _BatEngine:
+    """Engine-shaped fake under the real DynamicBatcher: instant
+    dispatch/fetch, no cost table (single-segment plans)."""
+
+    max_batch = 8
+    buckets = (4, 8)
+    platform = "cpu"
+    version = "v1"
+
+    @staticmethod
+    def _as_images(x) -> np.ndarray:
+        return np.asarray(x, dtype=np.uint8)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds {self.buckets[-1]}")
+
+    def bucket_costs(self) -> dict:
+        return {}
+
+    def dispatch(self, parts):
+        rows = sum(np.asarray(p).shape[0] for p in parts)
+        return types.SimpleNamespace(
+            n=rows, bucket=self.bucket_for(rows), version=self.version,
+            logits=np.full((rows, 10), 7.0, np.float32))
+
+    def fetch(self, handle) -> np.ndarray:
+        return handle.logits
+
+
+class BatcherMachine:
+    """Real DynamicBatcher (dispatch + completion threads under the
+    controller) vs concurrent submits, deadline sheds, queue-watermark
+    rejects and a racing stop(). The contract: every accepted future
+    resolves, nothing is stranded by stop, and the in-flight window
+    semaphore nets zero."""
+
+    name = "batcher"
+
+    def __init__(self, drain: bool = True):
+        self.drain = drain
+        self.batcher = None
+        self.futs: list = []
+        self.refused: list = []
+
+    def run(self, ctl) -> None:
+        import time
+
+        from distributedmnist_tpu.serve.batcher import DynamicBatcher
+
+        self.batcher = batcher = DynamicBatcher(
+            _BatEngine(), max_batch=8, max_wait_us=1000, queue_depth=8,
+            max_inflight=2, adaptive=False)
+        batcher.start()
+
+        def client(rows, use_deadline):
+            def body():
+                for _ in range(2):
+                    try:
+                        dl = (time.monotonic() + 0.002
+                              if use_deadline else None)
+                        self.futs.append(batcher.submit(
+                            np.zeros((rows, 4), np.uint8),
+                            deadline_s=dl))
+                    except Exception as e:
+                        # Rejected (watermark), DeadlineExceeded
+                        # (expired at submit), RuntimeError (stopped)
+                        self.refused.append(type(e).__name__)
+            return body
+
+        threads = [ctl.spawn(client(3, False), "client-a"),
+                   ctl.spawn(client(2, True), "client-b"),
+                   ctl.spawn(client(3, False), "client-c"),
+                   ctl.spawn(lambda: batcher.stop(drain=self.drain),
+                             "stopper")]
+        for t in threads:
+            t.join()
+        batcher.stop(drain=True)       # idempotent second stop
+        for fut in list(self.futs):
+            await_future(ctl, fut, "client-result")
+
+    def invariant(self, ctl) -> None:
+        b = self.batcher
+        if b is None:
+            return
+        if ctl.lock_free("batcher.inflight_gauge"):
+            assert b._inflight >= 0, "in-flight count went negative"
+            assert 0 <= b._dispatched <= b.max_inflight, (
+                f"dispatched-but-unresolved {b._dispatched} outside "
+                f"[0, {b.max_inflight}] — the window invariant")
+        if ctl.lock_free("batcher.queue"):
+            assert b._rows >= 0, "pending-row gauge went negative"
+
+    def final(self, ctl) -> None:
+        b = self.batcher
+        unresolved = [f for f in self.futs if not f.done()]
+        assert not unresolved, (
+            f"{len(unresolved)} accepted future(s) never resolved "
+            "across stop()")
+        assert len(self.futs) + len(self.refused) == 6, (
+            "client ops lost: "
+            f"{len(self.futs)} futures + {len(self.refused)} refusals")
+        assert b._rows == 0, f"pending rows {b._rows} at drain"
+        assert b._inflight == 0 and b._dispatched == 0, (
+            f"in-flight gauges nonzero at drain: {b._inflight}/"
+            f"{b._dispatched}")
+        balance = ctl.sem_balance.get("batcher.inflight_slots", 0)
+        assert balance == 0, (
+            f"window semaphore nets {balance:+d} at drain — a held "
+            "slot no error path released")
+        assert b._handles.empty(), "handle queue not drained"
+        self.invariant(ctl)
+
+
+# -- machine 4: fleet pick/failover/drain-rejoin ---------------------------
+
+
+class _FleetRouter:
+    """Per-replica router fake under the real ReplicaSet: version
+    pointer under its own named lock, schedulable fetch faults, and a
+    never-fetched-shaped handle so the abandoned-handle drain path
+    (the PR 8 staging-leak fix) is explored too."""
+
+    def __init__(self, rid: str):
+        self.replica = rid
+        self.max_batch = 8
+        self.buckets = (8,)
+        self.platform = "cpu"
+        self.n_chips = 1
+        self._lock = make_lock(f"harness.fleet.{rid}")
+        self._live = "v1"
+        self.fail_fetches = 0
+        self.dispatched = 0
+
+    def set_live(self, engine, version: str) -> None:
+        with self._lock:
+            self._live = version
+
+    def set_shadow(self, engine, version, fraction) -> None:
+        pass
+
+    def set_canary(self, engine, version, fraction) -> None:
+        pass
+
+    def clear_candidates(self) -> None:
+        pass
+
+    def live_version(self):
+        with self._lock:
+            return self._live
+
+    def live_infer_dtype(self):
+        return None
+
+    def live_route(self) -> tuple:
+        with self._lock:
+            return (self._live, None)
+
+    def routes(self) -> dict:
+        with self._lock:
+            return {"live": self._live, "canary": None, "shadow": None}
+
+    def versions_in_route(self) -> set:
+        with self._lock:
+            return {self._live}
+
+    def bucket_costs(self) -> dict:
+        return {8: 0.001}
+
+    def bucket_costs_p95(self) -> dict:
+        return {}
+
+    def dispatch(self, parts):
+        rows = sum(np.asarray(p).shape[0] for p in parts)
+        with self._lock:
+            self.dispatched += 1
+            v = self._live
+        return types.SimpleNamespace(
+            version=v, n=rows, bucket=8, infer_dtype=None,
+            handle=types.SimpleNamespace(staging="pinned"))
+
+    def fetch(self, rh) -> np.ndarray:
+        fail = False
+        with self._lock:
+            if self.fail_fetches > 0:
+                self.fail_fetches -= 1
+                fail = True
+        if fail:
+            raise RuntimeError(
+                f"injected fetch fault on {self.replica}")
+        return np.zeros((rh.n, 10), np.float32)
+
+
+class FleetMachine:
+    """Real ReplicaSet over 2 fake replica routers: 3 dispatch/fetch
+    workers vs injected replica-fetch faults (failover), an admin
+    drain/rejoin cycle, and a fleet-wide version roll. The contract:
+    no mixed-version pick window, windows/outstanding cost net zero,
+    and replica faults cost latency, never client errors."""
+
+    name = "fleet"
+
+    def __init__(self):
+        self.fleet = None
+        self.routers = None
+        self.errors: list = []
+        self.results: list = []
+
+    def run(self, ctl) -> None:
+        from distributedmnist_tpu.serve.fleet import ReplicaSet
+
+        self.routers = [_FleetRouter("r0"), _FleetRouter("r1")]
+        self.fleet = fleet = ReplicaSet(self.routers,
+                                        per_replica_inflight=1)
+        x = np.zeros((4, 28, 28, 1), np.uint8)
+
+        def worker():
+            for _ in range(2):
+                try:
+                    out = fleet.fetch(fleet.dispatch(x))
+                    self.results.append(out.shape)
+                except Exception as e:
+                    self.errors.append(f"{type(e).__name__}: {e}")
+
+        def failer():
+            with self.routers[0]._lock:
+                self.routers[0].fail_fetches = 2
+
+        def admin():
+            fleet.drain("r0")
+            fleet.rejoin("r0")
+
+        def roller():
+            fleet.set_live([object(), object()], "v2")
+
+        threads = [ctl.spawn(worker, "worker-a"),
+                   ctl.spawn(worker, "worker-b"),
+                   ctl.spawn(worker, "worker-c"),
+                   ctl.spawn(failer, "failer"),
+                   ctl.spawn(admin, "admin"),
+                   ctl.spawn(roller, "roller")]
+        for t in threads:
+            t.join()
+
+    def invariant(self, ctl) -> None:
+        fleet = self.fleet
+        if fleet is None:
+            return
+        if not ctl.lock_free("fleet.pick"):
+            return
+        live = {r._live for r in self.routers}
+        assert len(live) == 1, (
+            f"mixed-version pick window: replicas serve {sorted(live)}")
+        for rep in fleet.replicas:
+            assert rep.inflight >= 0, (
+                f"replica {rep.rid} in-flight window went negative")
+            assert rep.outstanding_s >= -1e-9, (
+                f"replica {rep.rid} outstanding cost went negative")
+
+    def final(self, ctl) -> None:
+        assert not self.errors, (
+            "replica faults leaked to clients instead of failing over: "
+            f"{self.errors}")
+        assert len(self.results) == 6, (
+            f"lost client ops: {len(self.results)}/6 results")
+        for rep in self.fleet.replicas:
+            assert rep.inflight == 0, (
+                f"replica {rep.rid} holds {rep.inflight} window "
+                "slot(s) at drain")
+            assert abs(rep.outstanding_s) < 1e-9, (
+                f"replica {rep.rid} outstanding cost "
+                f"{rep.outstanding_s} at drain")
+        self.invariant(ctl)
+
+
+def _batcher_nodrain() -> BatcherMachine:
+    return BatcherMachine(drain=False)
+
+
+MACHINES = {
+    "cache": CacheMachine,
+    "registry": RegistryMachine,
+    "batcher": BatcherMachine,
+    # stop(drain=False) is the path whose resolve-under-lock race this
+    # PR fixed (lint DML009): it gets its own explored machine so the
+    # fix is pinned dynamically too, not just statically.
+    "batcher-nodrain": _batcher_nodrain,
+    "fleet": FleetMachine,
+}

@@ -79,6 +79,35 @@ RULES = {
         "`with <...>_lock:` block is a torn-LRU / double-resolved-"
         "follower race the sanitizer can only catch if it happens to "
         "fire — the lint rejects the shape outright"),
+    "DML009": (
+        "Future resolution reachable while a serve lock is held",
+        "set_result/set_exception run done-callbacks INLINE on the "
+        "resolving thread (the cache front's single-flight fan-out "
+        "among them): resolving under a lock stalls every concurrent "
+        "path through it and silently orders that lock under whatever "
+        "the callbacks take — the batcher.stop(drain=False) shape "
+        "fixed in ISSUE 11, checked interprocedurally over one module "
+        "(a helper whose every call site holds the lock counts as "
+        "under it)"),
+    "DML010": (
+        "shared-field mutation outside its inferred guarding lock",
+        "lock-containment INFERENCE generalizing DML008 beyond the "
+        "cache's two containers: when >= 2 mutation sites of a field "
+        "hold one common named lock (registry._state's version table, "
+        "the fleet pick-lock's _Replica accounting), a lock-free "
+        "mutation site of the same field is a torn-state race the "
+        "sanitizer can only catch if the schedule happens to expose "
+        "it — the model checker's static sibling (ISSUE 11)"),
+    "DML011": (
+        "jit-cache-key hazard: thread-local device pin / non-hashable "
+        "static arg",
+        "jax.default_device is THREAD-LOCAL and part of the jit cache "
+        "key — warmup pinned on one thread leaves every worker thread "
+        "cold (the dryrun serve-reload zero-recompile trap), a "
+        "steady-state recompile the compile-counter tests cannot "
+        "attribute; and a mutable-literal static arg cannot be hashed "
+        "into the cache key at all (TypeError at first call). Caught "
+        "statically in serving/bench code (ISSUE 11)"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -191,6 +220,430 @@ def _spec_segment_names(s: str) -> list:
         if _FAILPOINT_NAME_RE.match(name):
             names.append(name)
     return names
+
+
+# -- dataflow machinery (DML009 / DML010) ----------------------------------
+#
+# Both rules need the same two ingredients, computed per module:
+#
+# 1. a LEXICAL lock context per statement — which named locks (attrs/
+#    vars bound from make_lock/make_rlock/make_condition, or anything
+#    the `_lock`-suffix convention names) are held via enclosing
+#    `with` blocks, with nested function/lambda bodies excluded (a
+#    callback DEFINED under a lock does not RUN under it);
+# 2. an INTERPROCEDURAL "always held" set per function — the
+#    intersection of the effective lock context over every local call
+#    site (`self.f()` / bare `f()`), iterated to fixpoint, so a helper
+#    like registry._evict_locked whose every caller holds _state is
+#    analyzed as under _state even though its own body has no `with`.
+
+_FUTURE_RESOLVERS = frozenset(("set_result", "set_exception"))
+_MUTATING_METHODS_ANY = _MUTATING_METHODS | frozenset(
+    ("appendleft", "extend", "insert", "add", "discard", "remove",
+     "popleft", "rotate"))
+_LOCK_FACTORIES = frozenset(("make_lock", "make_rlock",
+                             "make_condition"))
+
+
+@dataclasses.dataclass
+class _FuncFlow:
+    """One function's lock-relevant facts. Functions are keyed by a
+    CLASS-QUALIFIED name ('Registry.promote', bare for module level) so
+    same-named methods of different classes never conflate — a lock-free
+    `Y.finish()` must not inherit `X.finish()`'s Future resolution."""
+
+    name: str
+    cls: Optional[str] = None
+    resolves: list = dataclasses.field(default_factory=list)
+    # (lineno, lexical locks) of direct .set_result/.set_exception
+    calls: list = dataclasses.field(default_factory=list)
+    # raw: (kind 'self'|'bare', callee shortname, lineno, lexical locks);
+    # _collect_flows resolves these to qualified callee names
+    mutations: list = dataclasses.field(default_factory=list)
+    # (attr, lineno, lexical locks, description, receiver-is-self)
+
+
+def _lock_attr_names(tree: ast.AST) -> frozenset:
+    """Names bound from the lock factories — the module's lock
+    vocabulary ('_state', '_admin', '_cond', a local 'cv', ...)."""
+    names = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _call_name(node.value.func) in _LOCK_FACTORIES):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    names.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    names.add(t.id)
+    return frozenset(names)
+
+
+def _lock_token(expr: ast.AST, lock_names: frozenset) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in lock_names or expr.attr.endswith("_lock"):
+            return expr.attr
+    elif isinstance(expr, ast.Name):
+        if expr.id in lock_names or expr.id.endswith("_lock"):
+            return expr.id
+    return None
+
+
+def _base_attr(e: ast.AST, lock_names: frozenset) -> Optional[str]:
+    """The field an expression mutates, seen through subscripts:
+    `self._versions[k]` -> '_versions'. Thread-local state (receiver
+    chain through `_tls`) is per-thread by construction and exempt;
+    lock objects themselves are not 'fields'."""
+    while isinstance(e, ast.Subscript):
+        e = e.value
+    if not isinstance(e, ast.Attribute):
+        return None
+    v = e.value
+    if isinstance(v, ast.Attribute) and v.attr == "_tls":
+        return None
+    if isinstance(v, ast.Name) and v.id in ("_tls", "tls"):
+        return None
+    attr = e.attr
+    if attr in lock_names or attr.endswith("_lock"):
+        return None
+    return attr
+
+
+def _recv_is_self(e: ast.AST) -> bool:
+    """True when the mutated field hangs directly off self/cls (so it
+    belongs to the enclosing class); `replica.windows` or
+    `self._replicas[r].q` mutate ANOTHER object's field and stay in the
+    module-wide bucket."""
+    while isinstance(e, ast.Subscript):
+        e = e.value
+    if isinstance(e, ast.Attribute):
+        v = e.value
+        return isinstance(v, ast.Name) and v.id in ("self", "cls")
+    return False
+
+
+def _walk_exec(node: ast.AST, held: frozenset, flow: _FuncFlow,
+               lock_names: frozenset) -> None:
+    """Record calls/resolves/mutations with their lexical lock context;
+    nested function and lambda bodies are separate execution scopes."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return
+    if isinstance(node, ast.With):
+        tokens = {t for t in (
+            _lock_token(item.context_expr, lock_names)
+            for item in node.items) if t}
+        for item in node.items:
+            _walk_exec(item.context_expr, held, flow, lock_names)
+        inner = held | frozenset(tokens)
+        for stmt in node.body:
+            _walk_exec(stmt, inner, flow, lock_names)
+        return
+    if isinstance(node, ast.Call):
+        func = node.func
+        cname = _call_name(func)
+        if (cname in _FUTURE_RESOLVERS
+                and isinstance(func, ast.Attribute)):
+            flow.resolves.append((node.lineno, held))
+        if isinstance(func, ast.Name):
+            flow.calls.append(("bare", func.id, node.lineno, held))
+        elif (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls")):
+            flow.calls.append(("self", func.attr, node.lineno, held))
+        if (isinstance(func, ast.Attribute)
+                and func.attr in _MUTATING_METHODS_ANY):
+            attr = _base_attr(func.value, lock_names)
+            if attr:
+                flow.mutations.append(
+                    (attr, node.lineno, held, f"{attr}.{func.attr}()",
+                     _recv_is_self(func.value)))
+    elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for el in elts:
+                if isinstance(el, ast.Subscript):
+                    attr = _base_attr(el, lock_names)
+                    desc = f"{attr}[...] = ..." if attr else None
+                elif isinstance(el, ast.Attribute):
+                    attr = _base_attr(el, lock_names)
+                    desc = f"{attr} = ..." if attr else None
+                else:
+                    attr = desc = None
+                if attr:
+                    flow.mutations.append(
+                        (attr, node.lineno, held, desc,
+                         _recv_is_self(el)))
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            attr = _base_attr(t, lock_names)
+            if attr:
+                flow.mutations.append(
+                    (attr, node.lineno, held, f"del {attr}[...]",
+                     _recv_is_self(t)))
+    for child in ast.iter_child_nodes(node):
+        _walk_exec(child, held, flow, lock_names)
+
+
+def _collect_flows(tree: ast.AST, lock_names: frozenset) -> list:
+    flows = []
+
+    def visit(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = f"{cls}.{child.name}" if cls else child.name
+                flow = _FuncFlow(qual, cls=cls)
+                for stmt in child.body:
+                    _walk_exec(stmt, frozenset(), flow, lock_names)
+                flows.append(flow)
+                # nested defs close over self, so they keep the class
+                # context (a nested def in a method calling self.f()
+                # targets the same class)
+                visit(child, cls)
+            else:
+                visit(child, cls)
+
+    visit(tree, None)
+    names = {f.name for f in flows}
+    for f in flows:
+        resolved = []
+        for kind, callee, lineno, held in f.calls:
+            if kind == "self":
+                target = f"{f.cls}.{callee}" if f.cls else callee
+            elif callee in names:          # bare: module-level first
+                target = callee
+            elif f.cls and f"{f.cls}.{callee}" in names:
+                target = f"{f.cls}.{callee}"   # nested def in a method
+            else:
+                target = callee
+            resolved.append((target, lineno, held))
+        f.calls = resolved
+    return flows
+
+
+def _always_held(flows: list) -> dict:
+    """Function name -> locks held at EVERY local call site (effective:
+    the caller's own always-held set is included), to fixpoint. A
+    function with no local call sites is a public entry — nothing is
+    known to be held."""
+    names = {f.name for f in flows}
+    always = {n: frozenset() for n in names}
+    for _ in range(5):
+        incoming: dict = {n: None for n in names}
+        for f in flows:
+            base = always[f.name]
+            for callee, _lineno, held in f.calls:
+                if callee in names:
+                    eff = held | base
+                    cur = incoming[callee]
+                    incoming[callee] = (eff if cur is None
+                                        else cur & eff)
+        new = {n: (incoming[n] if incoming[n] is not None
+                   else frozenset()) for n in names}
+        if new == always:
+            break
+        always = new
+    return always
+
+
+def _check_dml009(flows: list, always: dict, rel: str,
+                  findings: list) -> None:
+    names = {f.name for f in flows}
+    reaches = {f.name for f in flows if f.resolves}
+    changed = True
+    while changed:
+        changed = False
+        for f in flows:
+            if f.name in reaches:
+                continue
+            if any(c in reaches for c, _, _ in f.calls):
+                reaches.add(f.name)
+                changed = True
+    for f in flows:
+        base = always[f.name]
+        for lineno, held in f.resolves:
+            eff = held | base
+            if eff:
+                findings.append(Finding(
+                    rel, lineno, "DML009",
+                    "future resolved while holding "
+                    f"{sorted(eff)} — done-callbacks run inline on "
+                    "this thread (the single-flight fan-out among "
+                    "them): move the set_result/set_exception outside "
+                    "the lock (collect under it, resolve after)"))
+        for callee, lineno, held in f.calls:
+            eff = held | base
+            if (eff and callee in reaches and callee in names
+                    and callee != f.name and not always[callee]):
+                findings.append(Finding(
+                    rel, lineno, "DML009",
+                    f"call to {callee}() while holding {sorted(eff)} — "
+                    "it (transitively) resolves a Future, whose done-"
+                    "callbacks would then run under the lock"))
+
+
+def _check_dml010(flows: list, always: dict, rel: str,
+                  findings: list) -> None:
+    sites: dict = {}
+    for f in flows:
+        if f.name.split(".")[-1] in ("__init__", "__post_init__"):
+            continue
+        base = always[f.name]
+        for attr, lineno, held, desc, is_self in f.mutations:
+            # self-fields are per-class (same-named fields of two
+            # classes are DIFFERENT state); other receivers (`rep.q`,
+            # `self._replicas[r].windows`) pool module-wide — the
+            # fleet _Replica-fields class
+            owner = f.cls if is_self else None
+            sites.setdefault((owner, attr), []).append(
+                (lineno, held | base, desc))
+    for (_owner, attr), lst in sorted(
+            sites.items(), key=lambda kv: (kv[0][0] or "", kv[0][1])):
+        locked = [s for s in lst if s[1]]
+        bare = [s for s in lst if not s[1]]
+        if len(locked) < 2 or not bare:
+            continue
+        guard = frozenset.intersection(*[s[1] for s in locked])
+        if not guard:
+            continue          # no single consistent guard — ambiguous
+        gname = "/".join(sorted(guard))
+        for lineno, _eff, desc in bare:
+            findings.append(Finding(
+                rel, lineno, "DML010",
+                f"mutation `{desc}` outside inferred guard "
+                f"`{gname}` — {len(locked)} other mutation site(s) of "
+                f"`{attr}` in this module hold it (lock-containment "
+                "inference: registry version-table / fleet pick-lock "
+                "bug class)"))
+
+
+def _check_dml011(tree: ast.AST, rel: str, findings: list) -> None:
+    defs = {n.name: n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    jitted_statics: dict = {}     # bound name -> static param names
+
+    def _static_sets(call: ast.Call):
+        by_name: list = []
+        by_num: list = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                by_name = [c.value for c in ast.walk(kw.value)
+                           if isinstance(c, ast.Constant)
+                           and isinstance(c.value, str)]
+            elif kw.arg == "static_argnums":
+                by_num = [c.value for c in ast.walk(kw.value)
+                          if isinstance(c, ast.Constant)
+                          and isinstance(c.value, int)]
+        return by_name, by_num
+
+    for node in ast.walk(tree):
+        # (a) the thread-local device pin, both spellings
+        if (isinstance(node, ast.Attribute)
+                and node.attr == "default_device"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"):
+            findings.append(Finding(
+                rel, node.lineno, "DML011",
+                "jax.default_device is thread-local AND part of the "
+                "jit cache key: programs warmed on this thread stay "
+                "cold on every other worker thread (steady-state "
+                "recompiles — the dryrun serve-reload trap); place "
+                "arrays with explicit shardings/device_put instead"))
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == "config"
+                and isinstance(node.func.value.value, ast.Name)
+                and node.func.value.value.id == "jax"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "jax_default_device"):
+            findings.append(Finding(
+                rel, node.lineno, "DML011",
+                "jax.config.update('jax_default_device', ...) pins the "
+                "thread-local default device into the jit cache key — "
+                "the same cold-worker-thread recompile hazard as "
+                "jax.default_device"))
+        # (b) non-hashable static args on jax.jit
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "jit"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jax"):
+            continue
+        by_name, by_num = _static_sets(node)
+        if not by_name and not by_num:
+            continue
+        tgt = node.args[0] if node.args else None
+        fdef = defs.get(tgt.id) if isinstance(tgt, ast.Name) else None
+        static_params = set(by_name)
+        if fdef is not None:
+            params = list(fdef.args.posonlyargs) + list(fdef.args.args)
+            static_params |= {params[i].arg for i in by_num
+                              if 0 <= i < len(params)}
+            defaults = fdef.args.defaults
+            offset = len(params) - len(defaults)
+            for i, p in enumerate(params):
+                if p.arg not in static_params or i < offset:
+                    continue
+                d = defaults[i - offset]
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(Finding(
+                        rel, node.lineno, "DML011",
+                        f"static arg {p.arg!r} of jitted "
+                        f"{fdef.name}() defaults to a non-hashable "
+                        "mutable literal — the jit cache key cannot "
+                        "hash it (TypeError on the first defaulted "
+                        "call); use a tuple/frozen value"))
+    # (b continued) call sites of locally-jitted names passing mutable
+    # literals in static keyword positions
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "jit"
+                and isinstance(node.value.func.value, ast.Name)
+                and node.value.func.value.id == "jax"):
+            by_name, _ = _static_sets(node.value)
+            if by_name:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted_statics[t.id] = set(by_name)
+    if jitted_statics:
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in jitted_statics):
+                statics = jitted_statics[node.func.id]
+                for kw in node.keywords:
+                    if (kw.arg in statics
+                            and isinstance(kw.value,
+                                           (ast.List, ast.Dict,
+                                            ast.Set))):
+                        findings.append(Finding(
+                            rel, node.lineno, "DML011",
+                            f"non-hashable literal passed for static "
+                            f"arg {kw.arg!r} of jitted "
+                            f"{node.func.id}() — TypeError at the "
+                            "call; pass a tuple/frozen value"))
+
+
+def _dml009_scope(rel: str) -> bool:
+    return _primitive_scope(rel)
+
+
+def _dml010_scope(rel: str) -> bool:
+    return _in_serve_pkg(rel)
+
+
+def _dml011_scope(rel: str) -> bool:
+    return _thread_scope(rel)
 
 
 # -- the checker -----------------------------------------------------------
@@ -423,6 +876,20 @@ def lint_source(text: str, rel: str) -> list:
                             "(spans ending on another thread use "
                             "add_span with measured endpoints "
                             "instead)"))
+
+    # DML009/DML010: the interprocedural dataflow pass (shared lock
+    # vocabulary + always-held inference, computed once per module).
+    if _dml009_scope(rel) or _dml010_scope(rel):
+        lock_names = _lock_attr_names(tree)
+        flows = _collect_flows(tree, lock_names)
+        always = _always_held(flows)
+        if _dml009_scope(rel):
+            _check_dml009(flows, always, rel, findings)
+        if _dml010_scope(rel):
+            _check_dml010(flows, always, rel, findings)
+    # DML011: jit-cache-key hazards in serving/bench code.
+    if _dml011_scope(rel):
+        _check_dml011(tree, rel, findings)
     return findings
 
 

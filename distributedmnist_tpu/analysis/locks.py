@@ -21,9 +21,21 @@ sanitizer keep working inert in the next.
 
 from __future__ import annotations
 
+import queue
 import threading
 
 from distributedmnist_tpu.analysis import sanitize
+
+
+def _controller():
+    """The active schedule-exploration controller, or None. Imported
+    lazily so `python -m distributedmnist_tpu.analysis.explore` does
+    not re-execute an already-imported module (runpy warning); the
+    cost is one sys.modules lookup per factory CALL — construction
+    time only, never the serving hot path."""
+    from distributedmnist_tpu.analysis import explore
+
+    return explore.active_controller()
 
 
 class _SanLock:
@@ -180,17 +192,25 @@ class _SanSemaphore:
 
 def make_lock(name: str, blocking_ok: bool = False):
     """A named mutex: bare threading.Lock when no sanitizer is active,
-    an instrumented wrapper when one is. `blocking_ok=True` exempts
-    holders from the blocking-under-lock check (use for locks that
-    serialize slow work BY DESIGN, e.g. admin locks held across
-    warmups — never for anything the dispatch/completion path
-    crosses)."""
+    an instrumented wrapper when one is, and a schedule-explorer shadow
+    lock while a model-checking Controller is installed (ISSUE 11 —
+    every acquire/release becomes a controller yield point).
+    `blocking_ok=True` exempts holders from the blocking-under-lock
+    check (use for locks that serialize slow work BY DESIGN, e.g. admin
+    locks held across warmups — never for anything the dispatch/
+    completion path crosses)."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.new_lock(name)
     if sanitize.active_sanitizer() is None:
         return threading.Lock()
     return _SanLock(name, blocking_ok=blocking_ok)
 
 
 def make_rlock(name: str, blocking_ok: bool = False):
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.new_rlock(name)
     if sanitize.active_sanitizer() is None:
         return threading.RLock()
     return _SanRLock(name, blocking_ok=blocking_ok)
@@ -203,7 +223,12 @@ def make_condition(name: str, blocking_ok: bool = False):
     reentrant condition-lock path behaves identically sanitized and
     not. wait() releases through the wrapper's Condition protocol
     (_release_save/_acquire_restore), so the held-stack stays truthful
-    across waits at any recursion depth."""
+    across waits at any recursion depth. Under an explorer Controller
+    the condition is a shadow state machine whose untimed wait() wakes
+    only on notify — lost wakeups become reachable deadlocks."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.new_condition(name)
     if sanitize.active_sanitizer() is None:
         return threading.Condition()
     return threading.Condition(_SanRLock(name, blocking_ok=blocking_ok))
@@ -211,10 +236,29 @@ def make_condition(name: str, blocking_ok: bool = False):
 
 def make_semaphore(name: str, value: int = 1):
     """A named counting semaphore whose holds are resource-balanced by
-    the sanitizer (net zero at drain, never negative)."""
+    the sanitizer (net zero at drain, never negative) and schedule-
+    explored under a Controller."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.new_semaphore(name, value)
     if sanitize.active_sanitizer() is None:
         return threading.Semaphore(value)
     return _SanSemaphore(name, value)
+
+
+def make_fifo(name: str):
+    """A named unbounded FIFO hand-off queue (the serve idiom for
+    dispatch->completion handle queues and the shadow-comparison
+    queue). Production and sanitized runs get a bare queue.SimpleQueue
+    — there is nothing to balance-check, put never blocks. Under an
+    explorer Controller the FIFO is a shadow queue whose get() is a
+    yield point parked on non-empty, so the batcher's completion
+    hand-off is explorable instead of an uninstrumented real block
+    (ISSUE 11)."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.new_fifo(name)
+    return queue.SimpleQueue()
 
 
 def make_thread(target, name: str, daemon: bool, args: tuple = (),
@@ -224,7 +268,11 @@ def make_thread(target, name: str, daemon: bool, args: tuple = (),
     threads that forgot daemon=True and stranded pytest at exit, so
     the choice must be written down at every spawn site. Under a
     sanitizer the thread is registered for the leaked-non-daemon-thread
-    report."""
+    report; under an explorer Controller the thread is a controlled
+    (scheduler-gated) thread whose join is cooperative."""
+    ctl = _controller()
+    if ctl is not None:
+        return ctl.new_thread(target, name, daemon, args, kwargs)
     t = threading.Thread(target=target, name=name, args=args,
                          kwargs=kwargs or {}, daemon=daemon)
     san = sanitize.active_sanitizer()

@@ -1,0 +1,67 @@
+"""ANALYSIS_r*.json artifact emission (ISSUE 11): the analysis
+trajectory, numbered like the BENCH rounds.
+
+Perf has BENCH_serve_r*.json; analysis coverage gets the same
+treatment — every explorer sweep (scripts/explore.sh, or
+`python -m distributedmnist_tpu.analysis.explore --emit`) and every
+opted-in sanitizer verdict (`Sanitizer.assert_clean(artifact=...)`, or
+DMNIST_ANALYSIS_ARTIFACT=1) writes a machine-readable round record:
+findings, schedules explored, seeds, wall time. Round numbers are
+allocated by scanning the repo root for existing ANALYSIS_r*.json —
+append-only history, never overwritten."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Optional
+
+_ROUND_RE = re.compile(r"^ANALYSIS_r(\d+)\.json$")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def existing_rounds(root: Optional[str] = None) -> list:
+    root = root or repo_root()
+    out = []
+    for fn in os.listdir(root):
+        m = _ROUND_RE.match(fn)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def next_round(root: Optional[str] = None) -> int:
+    rounds = existing_rounds(root)
+    return (rounds[-1] + 1) if rounds else 1
+
+
+def emit_analysis(payload: dict, root: Optional[str] = None,
+                  round: Optional[int] = None) -> str:
+    """Write one ANALYSIS_rNN.json round record and return its path.
+    The payload is annotated with the round number and a wall-clock
+    display stamp (provenance only — nothing orders by it)."""
+    root = root or repo_root()
+    record = dict(payload)
+    record.setdefault(
+        "generated_at",
+        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    while True:
+        rnd = round if round is not None else next_round(root)
+        path = os.path.join(root, f"ANALYSIS_r{rnd:02d}.json")
+        try:
+            fh = open(path, "x", encoding="utf-8")
+        except FileExistsError:
+            if round is not None:
+                raise
+            continue  # concurrent emitter took this round; re-scan
+        with fh:
+            record["round"] = rnd
+            json.dump(record, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+        return path

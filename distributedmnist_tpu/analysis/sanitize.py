@@ -262,11 +262,33 @@ class Sanitizer:
                                    if t.is_alive() and not t.daemon],
             }
 
-    def assert_clean(self) -> None:
+    def assert_clean(self, artifact=None) -> None:
         """Raise AssertionError naming every finding (cycle paths,
         blocking sites, nonzero balances). The drain contract: call
-        only after the pipeline has stopped."""
+        only after the pipeline has stopped.
+
+        `artifact` opts into the ANALYSIS_r*.json trajectory (ISSUE
+        11): True emits a round record to the repo root, a string
+        emits into that directory; DMNIST_ANALYSIS_ARTIFACT=1 turns it
+        on without a code change (serve.py's summary verdict). The
+        record is written whether the verdict is clean or not — a
+        clean round is a data point too, exactly like a BENCH run."""
         rep = self.report()
+        if artifact is None and os.environ.get(
+                "DMNIST_ANALYSIS_ARTIFACT", "").lower() in (
+                "1", "true", "on", "yes"):
+            artifact = True
+        if artifact:
+            from distributedmnist_tpu.analysis import report as report_mod
+
+            root = artifact if isinstance(artifact, str) else None
+            report_mod.emit_analysis(
+                {"kind": "sanitizer",
+                 "clean": not (rep["cycles"] or rep["blocking"]
+                               or rep["resource_errors"]
+                               or rep["balances"]
+                               or rep["leaked_threads"]),
+                 "report": rep}, root=root)
         problems = []
         for c in rep["cycles"]:
             problems.append(f"lock-order cycle: {c['detail']}")

@@ -79,7 +79,6 @@ from __future__ import annotations
 
 import hashlib
 import itertools
-import queue
 import threading
 import time
 from collections import deque
@@ -87,8 +86,9 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional
 
-from distributedmnist_tpu.analysis.locks import (make_condition, make_lock,
-                                                 make_semaphore, make_thread)
+from distributedmnist_tpu.analysis.locks import (make_condition, make_fifo,
+                                                 make_lock, make_semaphore,
+                                                 make_thread)
 from distributedmnist_tpu.serve import trace
 from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.serve.resilience import DeadlineExceeded
@@ -221,7 +221,10 @@ class DynamicBatcher:
         self._dispatched = 0
         self._inflight_lock = make_lock("batcher.inflight_gauge")
         # dispatch -> completion, FIFO; None is the shutdown sentinel.
-        self._handles: queue.SimpleQueue = queue.SimpleQueue()
+        # Named factory (ISSUE 11): a bare SimpleQueue in production,
+        # an explorable shadow queue under the schedule explorer — the
+        # completion hand-off is a yield point, not an opaque block.
+        self._handles = make_fifo("batcher.handles")
         self._dispatcher: Optional[threading.Thread] = None
         self._completer: Optional[threading.Thread] = None
 
@@ -346,16 +349,25 @@ class DynamicBatcher:
         their futures still resolve when their fetch lands (the threads
         are daemons; a wedged fetch is abandoned after a short join
         rather than holding stop() hostage)."""
+        dropped: list[_Request] = []
         with self._cond:
             self._stop = True
             if not drain:
                 while self._q:
                     req = self._q.popleft()
                     self._rows -= req.n
-                    err = RuntimeError("batcher stopped")
-                    self._finish_trace(req, error=err)
-                    req.future.set_exception(err)
+                    dropped.append(req)
             self._cond.notify_all()
+        # Futures resolve OUTSIDE the queue lock (lint DML009, the
+        # model checker's yield-point audit): a done-callback — the
+        # cache front's single-flight fan-out runs inline on whichever
+        # thread resolves — must never execute under batcher.queue,
+        # where it would stall every concurrent submit and order
+        # batcher.queue under whatever locks the callback takes.
+        for req in dropped:
+            err = RuntimeError("batcher stopped")
+            self._finish_trace(req, error=err)
+            req.future.set_exception(err)
         timeout = 30 if drain else 1
         for t in (self._dispatcher, self._completer):
             if t is not None:

@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import queue
 import random
 import threading
 import time
@@ -45,7 +44,8 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
-from distributedmnist_tpu.analysis.locks import make_lock, make_thread
+from distributedmnist_tpu.analysis.locks import (make_fifo, make_lock,
+                                                 make_thread)
 from distributedmnist_tpu.serve import trace
 from distributedmnist_tpu.serve.engine import InferenceEngine
 from distributedmnist_tpu.serve.faults import failpoint
@@ -140,7 +140,9 @@ class Router:
         # mode exists to prevent. Engine.fetch is thread-safe and
         # order-independent (staging pool is locked), so out-of-order
         # shadow fetches are fine.
-        self._shadow_q: queue.SimpleQueue = queue.SimpleQueue()
+        # Named FIFO factory (ISSUE 11): bare SimpleQueue in
+        # production, explorable under the schedule explorer.
+        self._shadow_q = make_fifo("router.shadow_q")
         self._shadow_pending = 0
         self._shadow_pending_lock = make_lock("router.shadow_pending")
         self._shadow_thread: Optional[threading.Thread] = None

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# The long-budget schedule-exploration sweep (ISSUE 11): 500 explored
+# schedules per serve state machine (cache single-flight vs promote
+# epoch, registry promote/rollback/eviction, batcher submit/shed/
+# drain/stop, fleet pick/failover/drain-rejoin), emitting an
+# ANALYSIS_r*.json round artifact (BENCH-style numbering) so analysis
+# coverage has a trajectory like perf does.
+#
+#   bash scripts/explore.sh                 # 500 schedules/machine
+#   bash scripts/explore.sh 2000            # a bigger budget
+#   bash scripts/explore.sh 1 --machines cache --seed 123
+#                                           # replay one failing seed
+#
+# Exit 0 clean, 1 on findings (each finding prints its replay seed —
+# a failing interleaving is a seed, not a flake). The tier-1 gate runs
+# the bounded --smoke preset instead (scripts/tier1.sh).
+cd "$(dirname "$0")/.." || exit 1
+schedules=500
+if [[ "${1:-}" =~ ^[0-9]+$ ]]; then
+    schedules="$1"
+    shift
+fi
+exec env JAX_PLATFORMS=cpu python -m distributedmnist_tpu.analysis.explore \
+    --schedules "$schedules" --emit "$@"

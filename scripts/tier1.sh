@@ -21,4 +21,11 @@ cd "$(dirname "$0")/.." || exit 1
 # fails the gate in ~a second instead of after a full pytest run.
 # scripts/lint.sh exit codes: 0 clean, 1 findings, 2 lint error.
 bash scripts/lint.sh || exit $?
+# The schedule-explorer smoke (ISSUE 11): fixed seeds, a bounded
+# budget per machine (<= 30 s total) over the four riskiest serve
+# state machines — promote-vs-insert and leader-vs-follower races are
+# PROVEN absent on the explored schedules, not sampled. Exit 1 on any
+# finding (the summary prints a replay seed). scripts/explore.sh runs
+# the 500-schedule long budget.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m distributedmnist_tpu.analysis.explore --smoke || exit $?
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

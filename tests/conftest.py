@@ -55,6 +55,14 @@ def pytest_configure(config):
         "suite")
     config.addinivalue_line(
         "markers",
+        "mc: deterministic concurrency model-checker test "
+        "(analysis/explore.py + analysis/harnesses.py: schedule "
+        "exploration over the serve state machines, planted-mutation "
+        "self-tests, replay determinism); fixed seeds and bounded "
+        "budgets, runs in tier-1 — `-m mc` selects just this suite; "
+        "scripts/explore.sh runs the long-budget sweep")
+    config.addinivalue_line(
+        "markers",
         "cache: prediction-cache / request-dedup test (serve/cache.py: "
         "the content-hash LRU front layer, single-flight collapse, "
         "invalidation-race coverage, the batcher's intra-batch dedup); "
@@ -78,6 +86,12 @@ def pytest_configure(config):
     from distributedmnist_tpu.analysis import sanitize
     if sanitize.active_sanitizer() is not None:
         sanitize.uninstall_sanitizer()
+    # Same trap, other env var (ISSUE 11): DMNIST_ANALYSIS_ARTIFACT=1
+    # makes assert_clean() emit an ANALYSIS_r*.json round record —
+    # under pytest that is every serve test's autouse teardown, which
+    # would litter the repo root with one artifact per test. The env
+    # opt-in is for serve.py runs; the suite never emits.
+    os.environ.pop("DMNIST_ANALYSIS_ARTIFACT", None)
 
 
 def committed_steps(ckpt_dir: str) -> list:

@@ -1,0 +1,359 @@
+"""analysis/explore.py + analysis/harnesses.py (ISSUE 11): the
+deterministic schedule explorer.
+
+Four layers of coverage:
+
+1. controller semantics on tiny inline models — mutual exclusion,
+   AB/BA deadlock detection (random AND systematic DFS), partial-order
+   reduction actually pruning independent-lock interleavings, condition
+   lost-wakeup reachability, semaphore balance accounting;
+2. the four serve state-machine harnesses exploring clean at HEAD
+   (bounded budgets; scripts/explore.sh runs the 500-schedule sweep);
+3. the mutation self-test — an explorer that cannot find PLANTED bugs
+   is theater: the skipped single-flight follower and the dropped
+   invalidation epoch bump must each be found within a bounded
+   schedule budget;
+4. replay determinism — a failing seed re-runs to the identical
+   interleaving and the identical finding, twice — plus the
+   ANALYSIS_r*.json artifact contract (BENCH-style round numbering,
+   emitted by both the explorer CLI and Sanitizer.assert_clean).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributedmnist_tpu.analysis import explore, harnesses, report
+from distributedmnist_tpu.analysis.locks import (make_condition, make_lock,
+                                                 make_semaphore)
+
+pytestmark = [pytest.mark.analysis, pytest.mark.mc]
+
+
+# -- tiny inline models ----------------------------------------------------
+
+
+class _CounterModel:
+    """Two threads increment a shared counter under one lock: always
+    clean, and the trace is a pure function of the seed."""
+
+    def __init__(self):
+        self.count = 0
+
+    def run(self, ctl):
+        lock = make_lock("model.counter")
+
+        def body():
+            for _ in range(3):
+                with lock:
+                    self.count += 1
+
+        a = ctl.spawn(body, "inc-a")
+        b = ctl.spawn(body, "inc-b")
+        a.join()
+        b.join()
+
+    def final(self, ctl):
+        assert self.count == 6
+
+
+class _AbBaModel:
+    """The classic AB/BA lock-order deadlock, reachable only under the
+    schedules where both threads hold their first lock."""
+
+    def run(self, ctl):
+        a = make_lock("model.A")
+        b = make_lock("model.B")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        x = ctl.spawn(t1, "t1")
+        y = ctl.spawn(t2, "t2")
+        x.join()
+        y.join()
+
+
+class _IndependentModel:
+    """Two threads, two unrelated locks: every interleaving is
+    protocol-equivalent, so DFS-with-POR must finish in ONE schedule."""
+
+    def run(self, ctl):
+        a = make_lock("model.A")
+        b = make_lock("model.B")
+
+        def t1():
+            with a:
+                pass
+
+        def t2():
+            with b:
+                pass
+
+        x = ctl.spawn(t1, "t1")
+        y = ctl.spawn(t2, "t2")
+        x.join()
+        y.join()
+
+
+class _WakeupModel:
+    """Producer/consumer over an UNTIMED condition wait. The correct
+    variant guards the wait with a state predicate the producer sets
+    under the lock — every schedule completes. The broken variant
+    waits unconditionally on a bare notify: schedules where the notify
+    lands before the wait are LOST WAKEUPS, which the explorer's
+    untimed-wait model makes reachable deadlocks instead of stalls."""
+
+    def __init__(self, correct: bool):
+        self.correct = correct
+        self.got = False
+
+    def run(self, ctl):
+        cond = make_condition("model.cv")
+        state = {"flag": False}
+
+        def producer():
+            with cond:
+                if self.correct:
+                    state["flag"] = True
+                cond.notify_all()
+
+        def consumer():
+            with cond:
+                if self.correct:
+                    while not state["flag"]:
+                        cond.wait()
+                else:
+                    cond.wait()      # lost if the notify already fired
+            self.got = True
+
+        p = ctl.spawn(producer, "producer")
+        c = ctl.spawn(consumer, "consumer")
+        p.join()
+        c.join()
+
+
+class _LeakModel:
+    """Semaphore acquired, never released: the controller's balance
+    accounting must read the held unit at drain."""
+
+    def run(self, ctl):
+        sem = make_semaphore("model.slots", 2)
+
+        def body():
+            sem.acquire()
+
+        t = ctl.spawn(body, "leaker")
+        t.join()
+
+    def final(self, ctl):
+        assert ctl.sem_balance.get("model.slots") == 0, (
+            "leaked slot")
+
+
+def _explore_n(factory, name, schedules, stop=True, policy="random",
+               base_seed=0):
+    ex = explore.Explorer(stop_on_finding=stop)
+    return ex.run(factory, name, schedules=schedules,
+                  base_seed=base_seed, policy=policy)
+
+
+# -- 1. controller semantics -----------------------------------------------
+
+
+def test_counter_model_clean_and_deterministic():
+    rep = _explore_n(_CounterModel, "counter", schedules=10, stop=False)
+    assert rep.schedules == rep.completed == 10
+    assert rep.findings == []
+    a = explore.replay(_CounterModel, 3)
+    b = explore.replay(_CounterModel, 3)
+    assert a.trace == b.trace and a.trace
+    assert a.finding is None
+
+
+def test_ab_ba_deadlock_found_by_random():
+    rep = _explore_n(_AbBaModel, "abba", schedules=50)
+    assert rep.findings, "AB/BA deadlock never found in 50 schedules"
+    f = rep.findings[0]
+    assert f["kind"] == "deadlock"
+    assert "model.A" in f["detail"] and "model.B" in f["detail"]
+
+
+def test_ab_ba_deadlock_found_by_dfs():
+    rep = _explore_n(_AbBaModel, "abba", schedules=200, policy="dfs")
+    assert rep.findings and rep.findings[0]["kind"] == "deadlock", (
+        "systematic DFS never reached the AB/BA interleaving")
+
+
+def test_dfs_por_prunes_independent_interleavings():
+    rep = _explore_n(_IndependentModel, "indep", schedules=100,
+                     stop=False, policy="dfs")
+    assert rep.findings == []
+    # two unrelated locks: every interleaving commutes, so sleep sets
+    # complete exactly ONE schedule and prune every sibling prefix,
+    # exhausting the tree well inside the budget
+    assert rep.completed == 1
+    assert rep.pruned == rep.schedules - 1
+    assert rep.schedules < 100, "DFS did not exhaust — POR not pruning"
+
+
+def test_lost_wakeup_reachable_only_without_predicate():
+    ok = _explore_n(lambda: _WakeupModel(correct=True), "wakeup-ok",
+                    schedules=40, stop=False)
+    assert ok.findings == []
+    bad = _explore_n(lambda: _WakeupModel(correct=False), "wakeup-bad",
+                     schedules=40)
+    assert bad.findings and bad.findings[0]["kind"] == "deadlock"
+    assert "model.cv" in bad.findings[0]["detail"]
+
+
+def test_semaphore_balance_leak_detected():
+    rep = _explore_n(_LeakModel, "leak", schedules=3)
+    assert rep.findings
+    f = rep.findings[0]
+    assert f["kind"] == "invariant" and "leaked slot" in f["detail"]
+
+
+def test_controller_refuses_stacking():
+    ctl = explore.Controller()
+    explore._active = ctl
+    try:
+        with pytest.raises(RuntimeError, match="already installed"):
+            explore.Controller().explore(_CounterModel())
+    finally:
+        explore._active = None
+
+
+def test_logical_clock_restored_after_run():
+    import time as _time
+
+    real = _time.monotonic
+    explore.replay(_CounterModel, 0)
+    assert _time.monotonic is real
+    assert _time.sleep is explore._REAL_SLEEP
+
+
+# -- 2. the four serve machines explore clean at HEAD ----------------------
+
+
+@pytest.mark.parametrize("machine", sorted(harnesses.MACHINES))
+def test_machine_explores_clean_at_head(machine):
+    rep = _explore_n(harnesses.MACHINES[machine], machine,
+                     schedules=40, stop=False)
+    assert rep.schedules == 40
+    assert rep.completed == 40, (
+        f"{machine}: {rep.schedules - rep.completed} schedule(s) did "
+        "not run to completion")
+    assert rep.findings == [], (
+        f"{machine} findings at HEAD:\n"
+        + "\n".join(f["detail"] for f in rep.findings))
+
+
+# -- 3. mutation self-test -------------------------------------------------
+
+
+def test_mutation_skipped_follower_is_found():
+    rep = _explore_n(
+        lambda: harnesses.CacheMachine(mutation="skip-follower"),
+        "cache-skip-follower", schedules=150)
+    assert rep.findings, (
+        "planted skip-follower bug not found within 150 schedules — "
+        "the explorer is theater")
+    f = rep.findings[0]
+    # the skipped follower's future never resolves: the waiting client
+    # deadlocks (or the final unresolved-future invariant trips)
+    assert f["kind"] in ("deadlock", "invariant")
+
+
+def test_mutation_dropped_epoch_bump_is_found():
+    rep = _explore_n(
+        lambda: harnesses.CacheMachine(mutation="drop-epoch-bump"),
+        "cache-drop-epoch", schedules=300)
+    assert rep.findings, (
+        "planted dropped-epoch-bump bug not found within 300 "
+        "schedules — the explorer is theater")
+    f = rep.findings[0]
+    assert f["kind"] == "invariant"
+    assert "stale bytes" in f["detail"]
+
+
+def test_mutations_do_not_leak_into_clean_machine():
+    """The mutation patches are scoped to the mutated run: a clean
+    machine explored right after a mutated one stays clean."""
+    _explore_n(lambda: harnesses.CacheMachine(mutation="skip-follower"),
+               "cache-skip-follower", schedules=30)
+    rep = _explore_n(harnesses.CacheMachine, "cache", schedules=20,
+                     stop=False)
+    assert rep.findings == [] and rep.completed == 20
+
+
+# -- 4. replay determinism + the ANALYSIS artifact -------------------------
+
+
+def test_failing_seed_replays_identically_twice():
+    """The ISSUE 11 contract: a failing interleaving is a replayable
+    seed, not a flake — identical trace AND identical finding, twice."""
+    factory = lambda: harnesses.CacheMachine(mutation="drop-epoch-bump")
+    rep = _explore_n(factory, "cache-drop-epoch", schedules=300)
+    assert rep.findings
+    seed = rep.findings[0]["seed"]
+    first = explore.replay(factory, seed)
+    second = explore.replay(factory, seed)
+    assert first.finding is not None
+    assert first.trace == second.trace
+    assert first.finding == second.finding
+    # and the replays reproduce the exploration's own finding
+    assert first.finding["kind"] == rep.findings[0]["kind"]
+    assert first.finding["detail"] == rep.findings[0]["detail"]
+
+
+def test_artifact_round_numbering(tmp_path):
+    root = str(tmp_path)
+    assert report.next_round(root) == 1
+    p1 = report.emit_analysis({"kind": "explorer", "x": 1}, root=root)
+    assert os.path.basename(p1) == "ANALYSIS_r01.json"
+    p2 = report.emit_analysis({"kind": "explorer", "x": 2}, root=root)
+    assert os.path.basename(p2) == "ANALYSIS_r02.json"
+    rec = json.loads(open(p2).read())
+    assert rec["round"] == 2 and rec["x"] == 2
+    assert "generated_at" in rec
+
+
+def test_assert_clean_emits_artifact(tmp_path):
+    from distributedmnist_tpu.analysis import sanitize
+
+    san = sanitize.install_sanitizer()
+    try:
+        san.assert_clean(artifact=str(tmp_path))
+    finally:
+        sanitize.uninstall_sanitizer()
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["ANALYSIS_r01.json"]
+    rec = json.loads(open(tmp_path / files[0]).read())
+    assert rec["kind"] == "sanitizer" and rec["clean"] is True
+    assert rec["report"]["cycles"] == []
+
+
+def test_cli_smoke_subprocess():
+    """The tier-1 wiring end to end: module CLI, exit 0, summary line
+    per machine, no artifact without --emit."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    before = set(report.existing_rounds())
+    out = subprocess.run(
+        [sys.executable, "-m", "distributedmnist_tpu.analysis.explore",
+         "--machines", "cache", "--schedules", "3", "--seed", "1"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "explore: cache" in out.stdout and "CLEAN" in out.stdout
+    assert set(report.existing_rounds()) == before

@@ -299,6 +299,226 @@ def test_dml008_wrong_lock_shape_not_enough():
     assert _rules(src) == ["DML008"]
 
 
+# -- DML009: future resolution under a serve lock (ISSUE 11) ---------------
+
+
+def test_dml009_direct_resolution_under_lock():
+    """The pre-ISSUE-11 batcher.stop(drain=False) shape: futures
+    failed while holding the queue condition."""
+    src = ("from distributedmnist_tpu.analysis.locks import "
+           "make_condition\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._cond = make_condition('batcher.queue')\n"
+           "    def stop(self, req, err):\n"
+           "        with self._cond:\n"
+           "            req.future.set_exception(err)\n")
+    assert _rules(src) == ["DML009"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert "_cond" in f.message
+
+
+def test_dml009_interprocedural_through_helper():
+    """A helper whose EVERY call site holds the lock is analyzed as
+    under it — the resolve inside fires even with no lexical with."""
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._lock = make_lock('x')\n"
+           "    def _fail(self, fut, err):\n"
+           "        fut.set_exception(err)\n"
+           "    def run(self, fut, err):\n"
+           "        with self._lock:\n"
+           "            self._fail(fut, err)\n")
+    assert _rules(src) == ["DML009"]
+
+
+def test_dml009_resolve_after_lock_is_clean():
+    """Collect-under-lock, resolve-after (the fixed batcher shape) and
+    callbacks REGISTERED under the lock (they run later, elsewhere)
+    are both fine."""
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._lock = make_lock('x')\n"
+           "    def run(self, fut):\n"
+           "        with self._lock:\n"
+           "            fut.add_done_callback(\n"
+           "                lambda d: d.set_result(None))\n"
+           "            dropped = [fut]\n"
+           "        for f in dropped:\n"
+           "            f.set_result(1)\n")
+    assert _rules(src) == []
+
+
+def test_dml009_helper_with_unlocked_callsite_flags_the_locked_one():
+    """A helper called both with and without the lock: the LOCKED call
+    site is the finding (the helper itself is not always-under-lock)."""
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._lock = make_lock('x')\n"
+           "    def _fail(self, fut):\n"
+           "        fut.set_exception(ValueError())\n"
+           "    def locked_path(self, fut):\n"
+           "        with self._lock:\n"
+           "            self._fail(fut)\n"
+           "    def clean_path(self, fut):\n"
+           "        self._fail(fut)\n")
+    findings = lint.lint_source(src, SERVE_REL)
+    assert [f.rule for f in findings] == ["DML009"]
+    assert findings[0].line == 9          # the locked call site
+
+
+def test_dml009_scope_is_serve_and_serve_py():
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._lock = make_lock('x')\n"
+           "    def run(self, fut):\n"
+           "        with self._lock:\n"
+           "            fut.set_result(1)\n")
+    assert _rules(src, "serve.py") == ["DML009"]
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+    assert _rules(src, "tests/test_serve_batcher.py") == []
+
+
+# -- DML010: lock-containment inference (ISSUE 11) -------------------------
+
+
+def test_dml010_inferred_guard_violation():
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class R:\n"
+           "    def __init__(self):\n"
+           "        self._state = make_lock('registry.state')\n"
+           "        self._versions = {}\n"
+           "    def a(self, k, v):\n"
+           "        with self._state:\n"
+           "            self._versions[k] = v\n"
+           "    def b(self, k):\n"
+           "        with self._state:\n"
+           "            del self._versions[k]\n"
+           "    def c(self, k):\n"
+           "        self._versions.pop(k, None)\n")
+    findings = lint.lint_source(src, SERVE_REL)
+    assert [f.rule for f in findings] == ["DML010"]
+    assert findings[0].line == 13
+    assert "_state" in findings[0].message
+
+
+def test_dml010_propagated_helper_is_clean():
+    """_evict_locked-style helpers: every call site holds the lock, so
+    the helper's mutations count as guarded."""
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class R:\n"
+           "    def __init__(self):\n"
+           "        self._state = make_lock('registry.state')\n"
+           "        self._versions = {}\n"
+           "    def a(self, k, v):\n"
+           "        with self._state:\n"
+           "            self._versions[k] = v\n"
+           "    def b(self, k):\n"
+           "        with self._state:\n"
+           "            del self._versions[k]\n"
+           "    def c(self, k):\n"
+           "        with self._state:\n"
+           "            self._evict(k)\n"
+           "    def _evict(self, k):\n"
+           "        self._versions.pop(k, None)\n")
+    assert _rules(src) == []
+
+
+def test_dml010_init_and_single_site_exempt():
+    """Constructors build unshared state; a field with fewer than two
+    locked mutation sites has no inferred guard to violate."""
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class R:\n"
+           "    def __init__(self):\n"
+           "        self._state = make_lock('s')\n"
+           "        self._table = {}\n"       # init: exempt
+           "    def a(self, k, v):\n"
+           "        with self._state:\n"
+           "            self._table[k] = v\n"  # one locked site only
+           "    def c(self, k):\n"
+           "        self._table.pop(k, None)\n")
+    assert _rules(src) == []
+
+
+def test_dml010_scope_is_serve_package_only():
+    src = ("from distributedmnist_tpu.analysis.locks import make_lock\n"
+           "class R:\n"
+           "    def __init__(self):\n"
+           "        self._state = make_lock('s')\n"
+           "        self._t = {}\n"
+           "    def a(self, k):\n"
+           "        with self._state:\n"
+           "            self._t[k] = 1\n"
+           "    def b(self, k):\n"
+           "        with self._state:\n"
+           "            self._t[k] = 2\n"
+           "    def c(self, k):\n"
+           "        self._t[k] = 3\n")
+    assert "DML010" in _rules(src)
+    assert _rules(src, "serve.py") == []
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+
+
+# -- DML011: jit-cache-key hazards (ISSUE 11) ------------------------------
+
+
+def test_dml011_default_device_flagged():
+    src = ("import jax\n"
+           "def warm(e):\n"
+           "    with jax.default_device(jax.devices()[0]):\n"
+           "        e.warmup()\n")
+    assert _rules(src) == ["DML011"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert "thread-local" in f.message
+    # bench.py and serve.py are in scope; training code is not
+    assert _rules(src, "bench.py") == ["DML011"]
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+
+
+def test_dml011_config_update_spelling_flagged():
+    src = ("import jax\n"
+           "jax.config.update('jax_default_device', None)\n")
+    assert _rules(src) == ["DML011"]
+
+
+def test_dml011_mutable_static_default():
+    src = ("import jax\n"
+           "def f(x, buckets=[1, 2]):\n"
+           "    return x\n"
+           "g = jax.jit(f, static_argnames=('buckets',))\n")
+    rules = _rules(src, "distributedmnist_tpu/serve/engine.py")
+    # engine.py is DML005-exempt, so the jit itself is fine — only the
+    # non-hashable static default fires
+    assert rules == ["DML011"]
+
+
+def test_dml011_mutable_literal_at_jitted_callsite():
+    src = ("import jax\n"
+           "def f(x, buckets=(1, 2)):\n"
+           "    return x\n"
+           "g = jax.jit(f, static_argnames=('buckets',))\n"
+           "y = g(1, buckets=[1, 2])\n")
+    rules = _rules(src, "distributedmnist_tpu/serve/engine.py")
+    assert rules == ["DML011"]
+    f = [x for x in lint.lint_source(
+        src, "distributedmnist_tpu/serve/engine.py")][0]
+    assert f.line == 5
+
+
+def test_dml011_hashable_statics_clean():
+    src = ("import jax\n"
+           "def f(x, buckets=(1, 2)):\n"
+           "    return x\n"
+           "g = jax.jit(f, static_argnames=('buckets',))\n"
+           "y = g(1, buckets=(1, 2))\n"
+           "h = jax.jit(f, donate_argnums=1)\n")
+    assert _rules(src, "distributedmnist_tpu/serve/engine.py") == []
+
+
 # -- allowlist pragma ------------------------------------------------------
 
 
