@@ -205,9 +205,21 @@ def main(argv=None) -> int:
                         "--baseline refuses deltas between cache-on "
                         "and cache-off zipf records the same way it "
                         "refuses cross-dtype ones")
+    p.add_argument("--serve-cache", action="store_true", default=None,
+                   help="[serve] wire the prediction cache + single-"
+                        "flight front (serve/cache.py) into the --chaos "
+                        "drill, with the registry's invalidation hook "
+                        "installed so the forced rollback exercises the "
+                        "epoch bump mid-storm; the leg then asserts the "
+                        "poison-isolation ledger EXACT on a leader "
+                        "basis — cached hits and collapsed followers "
+                        "must not distort the injector's poisoned-set "
+                        "accounting (ISSUE 12 satellite; the ROADMAP "
+                        "follow-up PR 10 left open)")
     p.add_argument("--serve-cache-capacity", type=int, default=None,
                    help="[serve] prediction-cache capacity in entries "
-                        "for the --zipf leg (default 4096)")
+                        "for the --zipf leg and --serve-cache chaos "
+                        "drill (default 4096)")
     p.add_argument("--dtype-sweep", action="store_true", default=None,
                    help="[serve] add the inference fast-path leg: warm "
                         "+ parity-gate bf16 and int8 variants, then "
@@ -279,6 +291,7 @@ def main(argv=None) -> int:
                    "--serve-infer-dtype": args.serve_infer_dtype,
                    "--zipf": args.zipf,
                    "--zipf-cache-off": args.zipf_cache_off,
+                   "--serve-cache": args.serve_cache,
                    "--serve-cache-capacity": args.serve_cache_capacity,
                    "--dtype-sweep": args.dtype_sweep,
                    "--baseline": args.baseline,
@@ -336,6 +349,10 @@ def main(argv=None) -> int:
         if args.zipf_cache_off and not args.zipf:
             p.error("--zipf-cache-off modifies the --zipf leg; pass "
                     "--zipf too")
+        if args.serve_cache and not args.chaos:
+            p.error("--serve-cache wires the cache front into the "
+                    "--chaos drill (the hot-key cache leg is --zipf); "
+                    "pass --chaos too")
         if args.serve_replicas is not None and args.serve_replicas < 1:
             p.error("--serve-replicas must be >= 1")
         if args.chaos:
@@ -1538,7 +1555,8 @@ def chaos_fault_spec(live_version: str, kill_target) -> str:
 
 def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
                      compiles, pipelined: int, duration: float,
-                     qps: float) -> dict:
+                     qps: float,
+                     cache_capacity: Optional[int] = None) -> dict:
     """The resilience proof leg (ISSUE 5 acceptance): a seeded fault
     schedule driven open-loop against the full resilience stack, with
     every request's outcome tracked individually.
@@ -1567,7 +1585,18 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
     fault load, not collateral): anything ELSE failing means a
     resilience path broke its neighbors. The whole leg must also stay
     recompile-free — bisection sub-segments and the rollback target
-    both reuse programs already on the bucket ladder."""
+    both reuse programs already on the bucket ladder.
+
+    With `cache_capacity` set (--serve-cache, ISSUE 12 satellite) the
+    whole drill runs THROUGH the prediction cache + single-flight
+    front, with the registry's invalidation hook installed so the
+    forced rollback exercises the epoch bump mid-storm. The poison
+    ledger is then asserted on a LEADER basis: a poisoned rid only
+    ever belongs to a flight leader (followers never reach dispatch,
+    hits never leave the cache), so client failures from dispatch
+    injection minus collapsed-follower echoes must equal the
+    injector's distinct poisoned set exactly — cached and collapsed
+    traffic must not distort the accounting."""
     import random
 
     import numpy as np
@@ -1626,8 +1655,26 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
             for n in sizes]
     batcher = make_batcher(pipelined, adaptive=False, wait_us=wait_us,
                            resilience=res)
+    cache = None
+    submitter = batcher
+    if cache_capacity is not None:
+        from distributedmnist_tpu.serve.cache import (CacheFront,
+                                                      PredictionCache)
+
+        cache = PredictionCache(cache_capacity)
+        # The real invalidation hook, not a test double: the forced
+        # breaker rollback mid-storm must bump the epoch atomically
+        # with the route swap, dropping any single-flight insert that
+        # raced it.
+        registry.set_cache(cache)
+        submitter = CacheFront(batcher, router, cache, metrics=metrics)
+        _mark(f"chaos: prediction cache front ON "
+              f"(capacity {cache_capacity})")
     outcomes: list = []
     futures: list = []
+    poison_echoes = 0        # collapsed followers re-raising a leader's
+    #   injected dispatch fault (one rid, N futures)
+    cache_hits_ok = 0
     try:
         metrics.reset()
         arrivals = random.Random(3)
@@ -1643,29 +1690,41 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
                 # an unmeetable budget: must shed pre-dispatch
                 deadline = time.monotonic() + 5e-4
             try:
-                futures.append(batcher.submit(reqs[i % len(reqs)],
-                                              deadline_s=deadline))
+                futures.append(submitter.submit(reqs[i % len(reqs)],
+                                                deadline_s=deadline))
             except DeadlineExceeded:
                 outcomes.append("deadline")
             except Rejected:
                 outcomes.append("rejected")
             i += 1
             next_t += arrivals.expovariate(qps)
-        _drain_or_die(batcher, timeout=120)
+        _drain_or_die(submitter, timeout=120)
         for fut in futures:
             try:
                 fut.result(timeout=60)
                 outcomes.append("ok")
+                if getattr(fut, "cache_hit", False):
+                    cache_hits_ok += 1
             except InjectedFault as e:
                 outcomes.append(f"injected:{e.point}")
+                if (e.point == "batch.dispatch"
+                        and getattr(fut, "collapsed", False)):
+                    poison_echoes += 1
             except DeadlineExceeded:
                 outcomes.append("deadline")
+            except Rejected:
+                # only reachable through the cache front: a follower
+                # echoing its leader's submit-time rejection — fault
+                # load, not collateral
+                outcomes.append("rejected")
             except Exception:
                 outcomes.append("other")
         snap = metrics.snapshot()
     finally:
         faults.uninstall()
-        batcher.stop()
+        submitter.stop()
+        if cache is not None:
+            registry.set_cache(None)
 
     n = len(outcomes)
     n_ok = outcomes.count("ok")
@@ -1683,6 +1742,11 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
     denom = max(n_ok + n_other, 1)
     availability = n_ok / denom
     poisoned = inj.poisoned()
+    # The leader-basis poison count: every poisoned rid belongs to
+    # exactly one dispatched (leader) request; collapsed followers
+    # re-raise the SAME fault instance without a rid of their own.
+    # Without the cache front poison_echoes is 0 and this is n_poison.
+    n_poison_leaders = n_poison - poison_echoes
     events = registry.events()
     rollbacks = [e for e in events if e.get("event") == "rollback"]
     recompiles = compiles.snapshot() - steady_from
@@ -1710,7 +1774,7 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
         "availability_ok": availability >= 0.99,
         "p99_under_faults_ms": snap["latency_ms"]["p99"],
         "poison_unique": len(poisoned),
-        "poison_isolated_exact": n_poison == len(poisoned) > 0,
+        "poison_isolated_exact": n_poison_leaders == len(poisoned) > 0,
         "bisect_splits": resil["bisect_splits"],
         "bisect_rescued_requests": resil["bisect_rescued_requests"],
         "deadline_shed_metric": resil["deadline_shed_requests"],
@@ -1728,6 +1792,32 @@ def _serve_chaos_leg(registry, router, factory, metrics, make_batcher,
         "failovers": snap["fleet"]["failovers_total"],
         "hedges": snap["fleet"]["hedges"],
     }
+    if cache is not None:
+        stats = cache.stats()
+        leg["cache"] = {
+            "enabled": True,
+            "capacity": cache_capacity,
+            "stats": stats,
+            "cache_hits_ok": cache_hits_ok,
+            "poison_client_failures": n_poison,
+            "poison_follower_echoes": poison_echoes,
+            "poison_leaders": n_poison_leaders,
+            # ISSUE 12 satellite acceptance: the ledger holds EXACTLY
+            # with the cache front on — hits bypass the failpoints
+            # without inventing rids, followers echo without drawing,
+            # and errors are never cached (a poisoned key re-elects a
+            # fresh leader with a fresh rid)
+            "ledger_exact": n_poison_leaders == len(poisoned) > 0,
+        }
+        _mark(f"chaos cache: {stats['hits']} hits "
+              f"({cache_hits_ok} served ok), {stats['collapsed']} "
+              f"collapsed, {poison_echoes} poison echoes, "
+              f"{n_poison_leaders} poison leaders vs "
+              f"{len(poisoned)} poisoned rids — ledger "
+              f"{'EXACT' if leg['cache']['ledger_exact'] else 'OFF'}; "
+              f"{stats['invalidations']} invalidations "
+              f"(rollback epoch bump), {stats['stale_drops']} stale "
+              "drops")
     if fleet is not None:
         kill_fires = sum(
             r["fires"] for r in inj.snapshot()["rules"]
@@ -1817,6 +1907,13 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
         "zipf_p99_on_ms": (
             (cur_d.get("zipf") or {}).get("p99_on_ms"),
             (base_d.get("zipf") or {}).get("p99_on_ms")),
+        # the compile-surface provenance row (ISSUE 12): static key
+        # count side by side; the fingerprint-set hash comparison is
+        # appended below the table (hashes don't delta as percentages).
+        # None-vs-None against pre-ISSUE 12 records.
+        "compile_surface_keys": (
+            (cur_d.get("compile_surface") or {}).get("static_keys"),
+            (base_d.get("compile_surface") or {}).get("static_keys")),
     }
     delta = {"path": path,
              "baseline_value": baseline.get("value"),
@@ -1832,6 +1929,21 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
               f" ({'+' if d is not None and d >= 0 else ''}{d}%)"
               if d is not None else
               f"  {name:<24} {prev} -> {cur}")
+    cur_cs = cur_d.get("compile_surface") or {}
+    base_cs = base_d.get("compile_surface") or {}
+    cur_h = cur_cs.get("fingerprint_set_hash")
+    base_h = base_cs.get("fingerprint_set_hash")
+    delta["compile_surface"] = {
+        "current_hash": cur_h,
+        "baseline_hash": base_h,
+        "match": (cur_h == base_h if cur_h and base_h else None),
+    }
+    if cur_h and base_h:
+        verdict = ("MATCH" if cur_h == base_h
+                   else "CHANGED — the compiled serving graphs differ "
+                        "between rounds")
+        _mark(f"  {'compile_surface_hash':<24} {base_h} -> {cur_h} "
+              f"({verdict})")
     return delta
 
 
@@ -2303,9 +2415,11 @@ def _serve(args) -> int:
             # 2x the sub-capacity sweep rate: drains must coalesce
             # several requests for poison isolation to have cohorts to
             # rescue
-            chaos = _serve_chaos_leg(registry, router, factory, metrics,
-                                     make_batcher, compiles, pipelined,
-                                     duration, 2 * low_qps)
+            chaos = _serve_chaos_leg(
+                registry, router, factory, metrics, make_batcher,
+                compiles, pipelined, duration, 2 * low_qps,
+                cache_capacity=((args.serve_cache_capacity or 4096)
+                                if args.serve_cache else None))
         finally:
             if chaos_tracer is not None:
                 trace_lib.uninstall()
@@ -2362,6 +2476,21 @@ def _serve(args) -> int:
               "steady state was supposed to be shape-stable")
     open_piped_low = next(r for r in table
                           if r["qps_target"] == low_qps)
+    # Compile-surface provenance (ISSUE 12 satellite): the static jit
+    # cache-key count and fingerprint-set hash of THIS record's serving
+    # geometry at its headline precision, computed by the abstract
+    # auditor (analysis/jaxcheck.py) on the canonical CPU trace basis —
+    # so a --baseline delta shows when two rounds' compiled surfaces
+    # silently diverged, alongside the host provenance that already
+    # guards silicon and dtype.
+    from distributedmnist_tpu.analysis import jaxcheck
+
+    compile_surface = jaxcheck.compile_surface_summary(
+        args.model, factory.buckets, factory.max_batch, headline_dtype,
+        fused_kernels=cfg.fused_kernels, cfg_dtype=args.dtype)
+    _mark(f"compile surface: {compile_surface['static_keys']} static "
+          f"keys at {headline_dtype}, fingerprint set "
+          f"{compile_surface['fingerprint_set_hash']}")
     record = {
         "metric": "serve_images_per_sec_per_chip",
         "value": round(value, 1),
@@ -2380,6 +2509,10 @@ def _serve(args) -> int:
             # conflated with TPU headlines when comparing rounds — the
             # host block makes every BENCH_serve_r*.json self-locating.
             "host": _host_provenance(factory, infer_dtype=headline_dtype),
+            # The static compile surface this record serves from
+            # (ISSUE 12): key count + fingerprint-set hash, the
+            # --baseline delta's compile-surface provenance row.
+            "compile_surface": compile_surface,
             "buckets": list(factory.buckets),
             "max_batch": factory.max_batch,
             "max_wait_us": max_wait_us,

@@ -1,0 +1,847 @@
+"""Static compile-surface auditor (ISSUE 12): prove the serving fleet's
+JAX cache-key universe closed, transfer-clean and fully warmed — before
+anything runs.
+
+Every hot-path guarantee since PR 1 rests on a RUNTIME compile counter
+noticing a recompile after the fact. This module is the ahead-of-time
+sibling (the concurrency plane got its own in PRs 8/11 — lint + model
+checker): it abstract-evaluates every forward the serving stack could
+ever dispatch — `jax.eval_shape` / `jax.make_jaxpr` over
+ShapeDtypeStructs, no device work, no data — and checks four properties
+statically:
+
+1. **Closed cache-key universe** (JX001/JX002). A jitted forward's
+   cache key is (function instance, input avals); one engine serves one
+   jitted forward whose per-bucket specializations are jit's own shape
+   cache, so the static key universe of a deployment is
+   {(model, infer_dtype, fused_mode, bucket rung)}. The REACHABLE side
+   is derived from request-admission semantics (every bucket
+   `bucket_for` can return for an admissible size 1..max_batch, which
+   also covers the registry's parity-gate batch); the WARMED side is
+   derived by running the real `InferenceEngine.warmup` against a
+   shape-recording probe (so a warmup edit that skips a rung is caught,
+   not assumed away), with the variant set mirroring what
+   `ModelRegistry.activate_infer_dtype` would warm. A
+   reachable-but-unwarmed key is a steady-state recompile waiting to
+   happen (the Clockwork violation); a warmed-but-unreachable key is
+   dead warmup cost.
+2. **Transfer hygiene** (JX003). The abstract pass runs under
+   `jax.transfer_guard("disallow")`, and each traced jaxpr's consts are
+   scanned for captured host ndarrays: a forward that closes over a
+   host array re-stages it implicitly instead of through the engine's
+   pooled staging + device_put path (lint DML012 polices the same class
+   at the AST level in serve/).
+3. **Weak-type / dtype drift** (JX004). A Python scalar reaching a
+   jitted forward as an ARGUMENT traces weak-typed and silently splits
+   the cache key against the committed-array spelling of the same call
+   (lint DML013's runtime shape); float64 avals or consts under the
+   repo's disabled-x64 regime are precision drift. Both are scanned in
+   the abstract values, where they are visible before any dispatch.
+4. **Graph fingerprints** (JX005). Each served forward's canonicalized
+   jaxpr is hashed into a stable fingerprint, snapshotted in-repo
+   (analysis/jaxpr_fingerprints.json). A PR that silently changes a
+   compiled serving graph — numerics, layer routing, quantization
+   scheme — fails the gate until the snapshot is regenerated with
+   `--update-snapshots --reason "..."`: the same
+   codify-past-review-findings stance as DML001-011, covering the PR 3
+   trap (thread-local default_device in the cache key) as a CLASS. The
+   training-step graphs train.py compiles are fingerprinted too.
+
+CLI: `python -m distributedmnist_tpu.analysis.jaxcheck` — exit 0 on a
+closed, clean, snapshot-matching surface; 1 on findings; 2 on internal
+error. `--emit` (or DMNIST_JAXCHECK_ARTIFACT=1) writes an
+ANALYSIS_r*.json round record via the PR 11 report machinery.
+scripts/tier1.sh runs the default audit after lint and the explorer
+smoke; scripts/jaxcheck.sh is the long-form artifact-emitting runner.
+
+Everything traces on the CPU host with a fixed 1-device canonical
+geometry, so the snapshot is identical under tier-1's bare CLI and the
+test suite's 8-virtual-device conftest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+# Rule registry: ID -> (summary, the hazard class it encodes).
+RULES = {
+    "JX001": (
+        "reachable-but-unwarmed jit cache key",
+        "a bucket rung a live request could land in that warmup never "
+        "compiled: the first request to hit it pays a steady-state "
+        "XLA compile — exactly the tail-latency poison Clockwork's "
+        "never-compile-on-the-hot-path rule (and every "
+        "recompiles_after_warmup == 0 assertion since PR 1) exists to "
+        "prevent"),
+    "JX002": (
+        "warmed-but-unreachable jit cache key",
+        "a bucket rung warmup compiles that no admissible request size "
+        "can ever reach: dead warmup cost on every version load and "
+        "swap, silently taxing promote latency and HBM"),
+    "JX003": (
+        "implicit host->device transfer in a served forward",
+        "the forward captures a host ndarray (a jaxpr const) instead "
+        "of taking it as a staged argument: the bytes bypass the "
+        "engine's pooled staging + device_put discipline and re-stage "
+        "on every program instantiation — the np-array-into-jitted-"
+        "call leak, caught abstractly under jax.transfer_guard "
+        "semantics (lint DML012 is the AST-level sibling in serve/)"),
+    "JX004": (
+        "weak-type / dtype drift splitting the jit cache key",
+        "a weak-typed (Python scalar) argument traces a DIFFERENT "
+        "cache key than the committed-array spelling of the same call "
+        "— one logical program, two compiles the counter cannot "
+        "attribute; float64 under the repo's disabled-x64 regime is "
+        "silent precision drift (lint DML013 is the AST-level "
+        "sibling)"),
+    "JX005": (
+        "jaxpr fingerprint drift vs the committed snapshot",
+        "a compiled serving graph changed without the snapshot being "
+        "regenerated: either an intended forward edit missing its "
+        "`--update-snapshots --reason` paper trail, or an UNintended "
+        "graph change riding along in a refactor — both must fail "
+        "until stated"),
+}
+
+SNAPSHOT_BASENAME = "jaxpr_fingerprints.json"
+
+# The canonical audited row geometry (the serving contract's image
+# shape; engine.py's IMAGE_SHAPE without importing jax at module load).
+_IMAGE_SHAPE = (28, 28, 1)
+
+
+def snapshot_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        SNAPSHOT_BASENAME)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    key: str          # the compile key / snapshot key the finding names
+    message: str
+
+    def format(self) -> str:
+        return f"{self.rule} [{self.key}] {self.message}"
+
+
+def key_str(model: str, infer_dtype: str, fused_mode: str,
+            bucket: int) -> str:
+    return f"{model}/{infer_dtype}/{fused_mode}/b{bucket}"
+
+
+# -- the audited deployment shape ------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditTarget:
+    """One deployment shape to audit: the knobs that decide which
+    compiled programs the registry could ever serve. Mirrors the
+    serving fields of Config (from_config lifts one); the canonical
+    defaults pin a 1-device geometry so fingerprints are identical on
+    every host the gate runs on."""
+
+    model: str
+    serve_max_batch: int = 512
+    n_chips: int = 1
+    serve_infer_dtype: str = "auto"
+    fused_kernels: str = "auto"
+    dtype: str = "float32"                 # cfg.dtype (compute dtype)
+    buckets: Optional[tuple] = None        # explicit ladder override
+
+    @classmethod
+    def from_config(cls, cfg, n_chips: int = 1,
+                    buckets: Optional[Sequence[int]] = None
+                    ) -> "AuditTarget":
+        return cls(model=cfg.model, serve_max_batch=cfg.serve_max_batch,
+                   n_chips=n_chips,
+                   serve_infer_dtype=cfg.serve_infer_dtype,
+                   fused_kernels=cfg.fused_kernels, dtype=cfg.dtype,
+                   buckets=tuple(buckets) if buckets else None)
+
+    def label(self) -> str:
+        return (f"{self.model}-mb{self.serve_max_batch}"
+                f"-c{self.n_chips}-{self.serve_infer_dtype}"
+                f"-{self.fused_kernels}")
+
+
+def default_targets() -> list:
+    """The gate's audit set: both models, the full auto dtype-variant
+    universe, on BOTH fused-kernel routes a deployment can pin (auto ->
+    XLA on the CPU audit host; pallas -> the Pallas kernels in
+    interpret mode — same graphs the TPU route compiles, minus the
+    backend lowering)."""
+    return [AuditTarget(model=m, fused_kernels=f)
+            for m in ("mlp", "lenet") for f in ("auto", "pallas")]
+
+
+def dtype_universe(serve_infer_dtype: str) -> tuple:
+    """Every serving precision the registry could route for this
+    setting: the f32 base always (bootstrap promotes it, and a refused
+    variant demotes back to it), plus the gated variant set —
+    registry.PARITY_GATES is read live so a new variant dtype widens
+    the audited universe automatically."""
+    from distributedmnist_tpu.serve.registry import PARITY_GATES
+
+    if serve_infer_dtype == "auto":
+        return ("float32",) + tuple(sorted(PARITY_GATES))
+    if serve_infer_dtype == "float32":
+        return ("float32",)
+    if serve_infer_dtype not in PARITY_GATES:
+        raise ValueError(
+            f"unknown serve_infer_dtype {serve_infer_dtype!r} (known: "
+            f"float32, auto, {sorted(PARITY_GATES)})")
+    return ("float32", serve_infer_dtype)
+
+
+# -- key universe: reachable vs warmed -------------------------------------
+
+
+def reachable_buckets(buckets: Sequence[int], max_batch: int) -> set:
+    """Bucket rungs an admissible request could land in: the image of
+    bucket_for over sizes 1..max_batch (pad-and-slice admission — the
+    batcher caps coalesced drains at max_batch, bisection only ever
+    shrinks, and the registry's parity batch is capped at max_batch
+    too, so this image IS the dispatchable set)."""
+    ladder = sorted(set(buckets))
+    out = set()
+    for n in range(1, max_batch + 1):
+        for b in ladder:
+            if b >= n:
+                out.add(b)
+                break
+    return out
+
+
+class _WarmupProbe:
+    """A shape-recording engine double the REAL InferenceEngine.warmup
+    runs against: records which bucket each warmup infer() would land
+    in (via the engine's own bucket_for) instead of computing. Keeps
+    the warmed set derived from the warmup CODE, not from a model of
+    it — a warmup edit that skips a rung changes the probe's record."""
+
+    def __init__(self, buckets: Sequence[int], infer_dtype: str):
+        self.buckets = tuple(sorted(set(buckets)))
+        self.infer_dtype = infer_dtype
+        self.warmed: set = set()
+        self._bucket_cost: dict = {}
+        self._bucket_cost_p95: dict = {}
+
+        class _NullCounter:
+            def snapshot(self) -> int:
+                return 0
+
+        self._compiles = _NullCounter()
+
+    def bucket_for(self, n: int) -> int:
+        from distributedmnist_tpu.serve.engine import InferenceEngine
+
+        return InferenceEngine.bucket_for(self, n)
+
+    def infer(self, x) -> None:
+        self.warmed.add(self.bucket_for(x.shape[0]))
+
+
+def warmed_buckets(buckets: Sequence[int], infer_dtype: str) -> set:
+    """The rungs `InferenceEngine.warmup` actually compiles for one
+    engine of this geometry, derived by running the real warmup against
+    a recording probe (module-level so tests can plant a regression)."""
+    from distributedmnist_tpu.serve.engine import InferenceEngine
+
+    probe = _WarmupProbe(buckets, infer_dtype)
+    InferenceEngine.warmup(probe, cost_samples=1)
+    return probe.warmed
+
+
+def crosscheck_keys(model: str, fused_mode: str, static: dict,
+                    warmed: dict, max_batch: int) -> list:
+    """JX001/JX002: static (reachable) vs warmed key sets, both given
+    as {infer_dtype: set(buckets)}. Each divergent key is a named
+    finding."""
+    findings = []
+    for dt in sorted(set(static) | set(warmed)):
+        reach = static.get(dt, set())
+        warm = warmed.get(dt, set())
+        for b in sorted(reach - warm):
+            findings.append(Finding(
+                "JX001", key_str(model, dt, fused_mode, b),
+                f"bucket {b} is reachable (requests of <= {max_batch} "
+                "rows can land in it) but warmup never compiles it — "
+                "the first such request pays a steady-state XLA "
+                "compile on the hot path"))
+        for b in sorted(warm - reach):
+            findings.append(Finding(
+                "JX002", key_str(model, dt, fused_mode, b),
+                f"bucket {b} is warmed but no admissible request size "
+                f"(1..{max_batch}) can reach it — dead warmup cost on "
+                "every load and swap"))
+    return findings
+
+
+# -- abstract forwards -----------------------------------------------------
+
+
+def _build_model(model_name: str, cfg_dtype: str, fused_kernels: str):
+    """The model exactly as build_model_and_mesh builds it, resolved
+    against the CPU audit host (auto conv -> lax, auto fused -> XLA,
+    pallas -> interpret — the canonical fingerprint basis)."""
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import models
+
+    dtype = jnp.bfloat16 if cfg_dtype == "bfloat16" else jnp.float32
+    return models.build(model_name, dtype=dtype, fused=fused_kernels,
+                        platform="cpu", conv="auto")
+
+
+def abstract_params(model):
+    """The params tree as ShapeDtypeStructs — jax.eval_shape over
+    model.init, zero device work (the registry's abstract_params
+    discipline, minus the sharding)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, *_IMAGE_SHAPE)))["params"],
+        jax.random.PRNGKey(0))
+
+
+def _zeros_like_tree(shapes):
+    import jax
+
+    return jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), shapes)
+
+
+def _avals_like_tree(tree):
+    import jax
+
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        tree)
+
+
+def abstract_forward(model, infer_dtype: str, fused_mode: str,
+                     param_shapes) -> tuple:
+    """(forward, params_avals) for one served precision — the same
+    forward construction the engine jits (engine.py for float32,
+    serve/quantize.py for the variants), minus the mesh-bound sharding
+    constraint (device placement is not part of the audited graph).
+    Variant preparation (quantization scales, folded normalization)
+    runs over zero-valued host params: prep SHAPES are value-
+    independent, and the prep tree is passed as a traced argument, so
+    no weight bytes ever enter the jaxpr."""
+    import jax.numpy as jnp
+
+    if infer_dtype == "float32":
+        dtype = getattr(model, "dtype", jnp.float32)
+
+        def forward(p, x_u8):
+            x = x_u8.astype(dtype) / 255.0
+            return model.apply({"params": p}, x)
+
+        return forward, param_shapes
+    from distributedmnist_tpu.serve.quantize import prepare_inference
+
+    prep, fast_forward = prepare_inference(
+        model, _zeros_like_tree(param_shapes), infer_dtype, fused_mode)
+    return fast_forward, _avals_like_tree(prep)
+
+
+# -- jaxpr tracing, hazard scan, fingerprints ------------------------------
+
+
+def trace_forward(fn: Callable, params_avals, bucket: int):
+    """The abstract pass for one (forward, bucket): make_jaxpr over
+    ShapeDtypeStructs under jax.transfer_guard('disallow') — no data,
+    no device work, and any concrete transfer attempted mid-trace
+    raises instead of silently staging."""
+    import jax
+
+    x_aval = jax.ShapeDtypeStruct((bucket, *_IMAGE_SHAPE), np.uint8)
+    with jax.transfer_guard("disallow"):
+        return jax.make_jaxpr(fn)(params_avals, x_aval)
+
+
+def audit_jaxpr(jaxpr, key: str) -> list:
+    """JX003/JX004 scan of one traced forward: captured host-array
+    consts, weak-typed argument avals, float64 anywhere."""
+    findings = []
+    for c in jaxpr.consts:
+        arr = np.asarray(c)
+        if arr.size > 1:
+            findings.append(Finding(
+                "JX003", key,
+                f"forward captures a host {arr.dtype} array of shape "
+                f"{arr.shape} ({arr.nbytes} bytes) as a jaxpr const — "
+                "host data must flow through the engine's staged "
+                "device_put arguments, never a closure"))
+        if arr.dtype in (np.float64, np.int64):
+            findings.append(Finding(
+                "JX004", key,
+                f"const of dtype {arr.dtype} under the repo's "
+                "disabled-x64 regime — silent 64-bit drift (truncated "
+                "at trace time, split key under x64)"))
+    for i, aval in enumerate(jaxpr.in_avals):
+        if getattr(aval, "weak_type", False):
+            findings.append(Finding(
+                "JX004", key,
+                f"argument {i} traces WEAK-TYPED ({aval.dtype}): a "
+                "Python scalar reached the jitted call — the same "
+                "call with a committed array compiles a second "
+                "program for the same logical shape"))
+        if np.dtype(aval.dtype) in (np.float64,):
+            findings.append(Finding(
+                "JX004", key,
+                f"argument {i} has dtype float64 under disabled x64 — "
+                "precision drift"))
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and np.dtype(dt) == np.float64:
+                findings.append(Finding(
+                    "JX004", key,
+                    f"intermediate value of dtype float64 "
+                    f"(primitive {eqn.primitive.name}) under disabled "
+                    "x64 — f64 upcast drift"))
+                break
+    return findings
+
+
+_ADDR_RE = None
+
+
+def fingerprint(jaxpr) -> str:
+    """Stable hash of the canonicalized jaxpr: the pretty-printed form
+    (deterministic variable naming per trace) with whitespace runs
+    collapsed and memory addresses scrubbed (custom_jvp eqn params
+    print closure thunks as `<function ... at 0x...>` — process-random
+    noise, not graph structure), sha256-truncated. Two traces of the
+    same forward at the same avals produce the same string; any graph
+    change — primitive, shape, dtype, parameter — changes it."""
+    global _ADDR_RE
+    if _ADDR_RE is None:
+        import re
+
+        _ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+    canon = _ADDR_RE.sub("0x0", " ".join(str(jaxpr).split()))
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def audit_forward(fn: Callable, params_avals, bucket: int,
+                  key: str) -> tuple:
+    """(fingerprint, findings) for one forward at one bucket — the
+    public per-forward entry the planted-hazard tests drive directly."""
+    jaxpr = trace_forward(fn, params_avals, bucket)
+    return fingerprint(jaxpr), audit_jaxpr(jaxpr, key)
+
+
+def fingerprint_set_hash(fps: dict) -> str:
+    """One hash over a whole {key: fingerprint} table — the
+    compile-surface provenance stamp bench records carry."""
+    canon = ";".join(f"{k}={v}" for k, v in sorted(fps.items()))
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+# -- per-target audit ------------------------------------------------------
+
+
+def audit_target(target: AuditTarget) -> dict:
+    """The full audit of one deployment shape: trace every (dtype,
+    bucket) forward, scan it, fingerprint it, and cross-check the
+    static key universe against the warmup-derived warmed set."""
+    from distributedmnist_tpu.ops import fused as fused_lib
+    from distributedmnist_tpu.serve.engine import make_buckets
+
+    mode = fused_lib.resolve(target.fused_kernels, "cpu")
+    buckets = (tuple(sorted(set(target.buckets))) if target.buckets
+               else make_buckets(target.serve_max_batch, target.n_chips))
+    model = _build_model(target.model, target.dtype,
+                         target.fused_kernels)
+    param_shapes = abstract_params(model)
+    dtypes = dtype_universe(target.serve_infer_dtype)
+
+    findings: list = []
+    fps: dict = {}
+    reach = reachable_buckets(buckets, target.serve_max_batch)
+    static = {dt: set(reach) for dt in dtypes}
+    warmed = {dt: warmed_buckets(buckets, dt) for dt in dtypes}
+    findings.extend(crosscheck_keys(target.model, mode, static, warmed,
+                                    target.serve_max_batch))
+    for dt in dtypes:
+        fn, avals = abstract_forward(model, dt, mode, param_shapes)
+        for b in sorted(set(buckets)):
+            k = key_str(target.model, dt, mode, b)
+            try:
+                fp, fnd = audit_forward(fn, avals, b, k)
+            except Exception as e:
+                findings.append(Finding(
+                    "JX003", k,
+                    "abstract trace failed under transfer_guard("
+                    f"'disallow'): {type(e).__name__}: {e}"))
+                continue
+            fps[k] = fp
+            findings.extend(fnd)
+    return {
+        "label": target.label(),
+        "model": target.model,
+        "fused_mode": mode,
+        "buckets": sorted(set(buckets)),
+        "max_batch": target.serve_max_batch,
+        "infer_dtypes": list(dtypes),
+        "static_keys": sum(len(v) for v in static.values()),
+        "warmed_keys": sum(len(v) for v in warmed.values()),
+        "fingerprints": fps,
+        "findings": findings,
+    }
+
+
+def train_step_fingerprints() -> tuple:
+    """({key: fp}, findings): the training-step graphs train.py
+    compiles, abstract-traced at the canonical geometry (1-device mesh,
+    each model's preset optimizer, packed pixels, batch 512, one step
+    per call) — a training-graph edit shows up in the snapshot gate
+    exactly like a serving-forward edit."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import optim
+    from distributedmnist_tpu.parallel import make_mesh
+    from distributedmnist_tpu import trainer
+
+    mesh = make_mesh(jax.devices("cpu")[:1])
+    fps: dict = {}
+    findings: list = []
+    presets = {"mlp": ("sgd", 0.1), "lenet": ("adam", 1e-3)}
+    train_n, batch = 2048, 512
+    for model_name, (opt, lr) in presets.items():
+        model = _build_model(model_name, "float32", "auto")
+        tx = optim.build(opt, lr, 0.9, flat=True)
+        state_avals = jax.eval_shape(
+            lambda k, m=model, t=tx: trainer.init_state(
+                k, m, t, jnp.zeros((1, *_IMAGE_SHAPE))),
+            jax.random.PRNGKey(0))
+        step = trainer.make_train_step(model, tx, mesh, mode="auto",
+                                       dtype=jnp.float32,
+                                       pixel_format="packed")
+        x_aval = jax.ShapeDtypeStruct((train_n, 196), np.int32)
+        y_aval = jax.ShapeDtypeStruct((train_n,), np.int32)
+        idx_aval = jax.ShapeDtypeStruct((1, batch), np.int32)
+        k = f"{model_name}/train/{opt}/packed-b{batch}x1"
+        try:
+            with jax.transfer_guard("disallow"):
+                jaxpr = jax.make_jaxpr(step)(state_avals, x_aval,
+                                             y_aval, idx_aval)
+        except Exception as e:
+            findings.append(Finding(
+                "JX003", k,
+                "abstract trace of the train step failed under "
+                f"transfer_guard('disallow'): {type(e).__name__}: {e}"))
+            continue
+        fps[k] = fingerprint(jaxpr)
+        findings.extend(audit_jaxpr(jaxpr, k))
+    return fps, findings
+
+
+# -- snapshot gate ---------------------------------------------------------
+
+
+_KEY_COMPONENTS = ("model", "infer_dtype", "fused_mode", "bucket")
+
+
+def _describe_key_delta(k: str, pool: Sequence[str]) -> str:
+    """Name the changed component when `k` differs from some key in
+    `pool` in exactly one of (model, infer_dtype, fused_mode, bucket) —
+    the changed-component naming the fingerprint-stability tests pin."""
+    parts = k.split("/")
+    for other in pool:
+        op = other.split("/")
+        if len(op) != len(parts):
+            continue
+        diffs = [i for i, (a, b) in enumerate(zip(parts, op)) if a != b]
+        if len(diffs) == 1:
+            i = diffs[0]
+            name = (_KEY_COMPONENTS[i] if i < len(_KEY_COMPONENTS)
+                    else f"component {i}")
+            return (f" (differs from {other} in {name}: "
+                    f"{op[i]} -> {parts[i]})")
+    return ""
+
+
+def diff_fingerprints(current: dict, snapshot: dict) -> list:
+    """JX005 findings for every divergence between two {key: fp}
+    tables: a changed fingerprint on a shared key names the forward as
+    changed; an added/removed key names the key component that moved
+    (bucket rung, dtype, fused mode, model) when one does."""
+    findings = []
+    for k in sorted(set(current) - set(snapshot)):
+        findings.append(Finding(
+            "JX005", k,
+            "new compile key not in the snapshot"
+            + _describe_key_delta(k, sorted(snapshot))
+            + " — regenerate with --update-snapshots --reason '...'"))
+    for k in sorted(set(snapshot) - set(current)):
+        findings.append(Finding(
+            "JX005", k,
+            "snapshot key no longer produced by the audit"
+            + _describe_key_delta(k, sorted(current))
+            + " — regenerate with --update-snapshots --reason '...'"))
+    for k in sorted(set(current) & set(snapshot)):
+        if current[k] != snapshot[k]:
+            findings.append(Finding(
+                "JX005", k,
+                f"compiled graph changed (fingerprint {snapshot[k]} -> "
+                f"{current[k]}): the served forward itself was edited "
+                "— regenerate with --update-snapshots --reason '...' "
+                "stating why"))
+    return findings
+
+
+def load_snapshot(path: Optional[str] = None) -> Optional[dict]:
+    path = path or snapshot_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_snapshot(all_fps: dict, reason: str,
+                   path: Optional[str] = None) -> str:
+    """Persist {table_label: {key: fp}} with the stated reason — the
+    regeneration paper trail the gate demands."""
+    import time
+
+    import jax
+
+    path = path or snapshot_path()
+    record = {
+        "jax_version": jax.__version__,
+        "reason": reason,
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+        "fingerprints": all_fps,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(record, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+# -- the whole audit -------------------------------------------------------
+
+
+def run_audit(targets: Optional[list] = None, with_train: bool = True,
+              snapshot: str = "compare",
+              snapshot_file: Optional[str] = None,
+              partial: bool = False) -> dict:
+    """The full gate pass. `snapshot` in {'compare', 'skip'}: compare
+    raises no error when the snapshot file is missing (first bootstrap)
+    or was written under a different jax version (graph printing may
+    legitimately differ) — both downgrade to warnings; a PRESENT,
+    same-version snapshot that diverges is JX005 findings. `partial`
+    marks a deliberately narrowed audit (--models subset, --no-train):
+    snapshot labels the audit never produced are then SKIPPED instead
+    of read as removed keys — only the full default audit may declare
+    a snapshot label dead."""
+    import jax
+
+    targets = default_targets() if targets is None else targets
+    per_target = [audit_target(t) for t in targets]
+    findings = [f for r in per_target for f in r["findings"]]
+    all_fps = {r["label"]: r["fingerprints"] for r in per_target}
+    if with_train:
+        train_fps, train_findings = train_step_fingerprints()
+        all_fps["train"] = train_fps
+        findings.extend(train_findings)
+    warnings: list = []
+    if snapshot == "compare":
+        snap = load_snapshot(snapshot_file)
+        if snap is None:
+            warnings.append(
+                "no fingerprint snapshot found — bootstrap with "
+                "--update-snapshots --reason 'initial snapshot'")
+        elif snap.get("jax_version") != jax.__version__:
+            warnings.append(
+                f"snapshot was written under jax "
+                f"{snap.get('jax_version')}, this host runs "
+                f"{jax.__version__} — fingerprint comparison skipped "
+                "(jaxpr printing may legitimately differ across "
+                "versions); regenerate to re-arm the gate")
+        else:
+            snap_fps = snap.get("fingerprints", {})
+            labels = (sorted(all_fps) if partial
+                      else sorted(set(all_fps) | set(snap_fps)))
+            for label in labels:
+                findings.extend(diff_fingerprints(
+                    all_fps.get(label, {}), snap_fps.get(label, {})))
+    static_total = sum(r["static_keys"] for r in per_target)
+    warmed_total = sum(r["warmed_keys"] for r in per_target)
+    return {
+        "kind": "jaxcheck",
+        "jax_version": jax.__version__,
+        "targets": [
+            {k: v for k, v in r.items() if k != "findings"}
+            for r in per_target],
+        "static_keys_total": static_total,
+        "warmed_keys_total": warmed_total,
+        "fingerprint_set_hash": fingerprint_set_hash(
+            {f"{lbl}:{k}": v for lbl, fps in all_fps.items()
+             for k, v in fps.items()}),
+        "fingerprints": all_fps,
+        "findings": findings,
+        "warnings": warnings,
+        "closed": not findings,
+    }
+
+
+def compile_surface_summary(model: str, buckets: Sequence[int],
+                            max_batch: int, infer_dtype: str,
+                            fused_kernels: str = "auto",
+                            cfg_dtype: str = "float32") -> dict:
+    """The compile-surface provenance block bench records carry
+    (ISSUE 12 satellite): static key count + fingerprint-set hash for
+    ONE deployment geometry at its headline serving precision — cheap
+    (a couple dozen abstract traces), and enough for --baseline to
+    refuse comparing records whose compiled surfaces differ silently."""
+    target = AuditTarget(
+        model=model, serve_max_batch=max_batch, n_chips=1,
+        serve_infer_dtype=infer_dtype, fused_kernels=fused_kernels,
+        dtype=cfg_dtype, buckets=tuple(buckets))
+    r = audit_target(target)
+    import jax
+
+    return {
+        "static_keys": r["static_keys"],
+        "fingerprint_set_hash": fingerprint_set_hash(r["fingerprints"]),
+        "infer_dtypes": r["infer_dtypes"],
+        "fused_mode": r["fused_mode"],
+        "jax_version": jax.__version__,
+        "findings": len(r["findings"]),
+    }
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributedmnist_tpu.analysis.jaxcheck",
+        description="Static compile-surface auditor: abstract-evaluate "
+                    "every forward the serving registry could dispatch, "
+                    "prove the jit cache-key universe closed (warmed == "
+                    "reachable), transfer-clean and weak-type-free, and "
+                    "gate the jaxpr fingerprints against the committed "
+                    "snapshot. Exit 0 clean, 1 findings, 2 internal "
+                    "error.")
+    p.add_argument("--models", default="mlp,lenet",
+                   help="comma-separated models to audit (default both)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the JX rule table and exit")
+    p.add_argument("--no-train", action="store_true",
+                   help="skip the training-step fingerprints")
+    p.add_argument("--no-snapshot", action="store_true",
+                   help="skip the fingerprint snapshot gate")
+    p.add_argument("--update-snapshots", action="store_true",
+                   help="regenerate analysis/jaxpr_fingerprints.json "
+                        "from this audit (requires --reason)")
+    p.add_argument("--reason", default=None,
+                   help="[--update-snapshots] WHY the compiled surface "
+                        "changed — recorded in the snapshot")
+    p.add_argument("--emit", action="store_true",
+                   help="write an ANALYSIS_r*.json round artifact "
+                        "(BENCH-style numbering; also triggered by "
+                        "DMNIST_JAXCHECK_ARTIFACT=1)")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (summary, why) in sorted(RULES.items()):
+            print(f"{rule}  {summary}\n        {why}")
+        return 0
+    if args.update_snapshots and not args.reason:
+        print("jaxcheck: --update-snapshots requires --reason '...' — "
+              "a regenerated surface without a stated why is exactly "
+              "the silent drift the gate exists to catch",
+              file=sys.stderr)
+        return 2
+    models = [m.strip() for m in args.models.split(",") if m.strip()]
+    unknown = [m for m in models if m not in ("mlp", "lenet")]
+    if unknown:
+        print(f"jaxcheck: unknown model(s) {unknown}", file=sys.stderr)
+        return 2
+    targets = [t for t in default_targets() if t.model in models]
+    # A narrowed audit (one model, or --no-train) still gates the
+    # labels it covers, but must neither read the snapshot's OTHER
+    # labels as removed keys nor overwrite them on --update-snapshots.
+    partial = args.no_train or set(models) != {"mlp", "lenet"}
+    if args.update_snapshots and partial:
+        import jax
+
+        existing = load_snapshot()
+        if (existing is not None
+                and existing.get("jax_version") != jax.__version__):
+            print("jaxcheck: refusing a PARTIAL --update-snapshots "
+                  "over a snapshot written under jax "
+                  f"{existing.get('jax_version')} (this host runs "
+                  f"{jax.__version__}): merging would stamp the "
+                  "snapshot with the new version while the unaudited "
+                  "labels still carry the old version's jaxpr "
+                  "printing, re-arming the JX005 gate against them — "
+                  "run a FULL --update-snapshots instead",
+                  file=sys.stderr)
+            return 2
+    try:
+        report = run_audit(
+            targets, with_train=not args.no_train,
+            snapshot="skip" if (args.no_snapshot
+                               or args.update_snapshots) else "compare",
+            partial=partial)
+    except Exception as e:     # a broken auditor must never read clean
+        print(f"jaxcheck: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.update_snapshots:
+        fps = report["fingerprints"]
+        if partial:
+            existing = load_snapshot()
+            merged = dict((existing or {}).get("fingerprints", {}))
+            merged.update(fps)
+            fps = merged
+        path = write_snapshot(fps, args.reason)
+        print(f"jaxcheck: snapshot regenerated at {path} "
+              f"({'partial audit merged into existing labels' if partial else 'full surface'}; "
+              f"reason: {args.reason})")
+
+    for w in report["warnings"]:
+        print(f"jaxcheck: WARNING: {w}", file=sys.stderr)
+    for f in sorted(report["findings"],
+                    key=lambda f: (f.rule, f.key)):
+        print(f.format())
+    n = len(report["findings"])
+    print(f"jaxcheck: {len(report['targets'])} target(s), "
+          f"{report['static_keys_total']} static keys / "
+          f"{report['warmed_keys_total']} warmed, fingerprint set "
+          f"{report['fingerprint_set_hash']} — "
+          f"{'CLOSED, 0 findings' if n == 0 else f'{n} finding(s)'}",
+          file=sys.stderr)
+
+    if args.emit or os.environ.get("DMNIST_JAXCHECK_ARTIFACT"):
+        from distributedmnist_tpu.analysis import report as report_mod
+
+        payload = dict(report)
+        payload["findings"] = [dataclasses.asdict(f)
+                               for f in report["findings"]]
+        path = report_mod.emit_analysis(payload)
+        print(f"jaxcheck: artifact written to {path}")
+    return 1 if report["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,36 @@ RULES = {
         "attribute; and a mutable-literal static arg cannot be hashed "
         "into the cache key at all (TypeError at first call). Caught "
         "statically in serving/bench code (ISSUE 11)"),
+    "DML012": (
+        "implicit host->device array conversion in serve/ outside the "
+        "engine staging path",
+        "np arrays flow onto the device ONLY through engine.py's "
+        "pooled staging + device_put discipline (and quantize.py's "
+        "build-time weight preparation): a jnp.array/jnp.asarray/"
+        "jax.device_put anywhere else in serve/ is an implicit "
+        "per-call host->device transfer the staging pool, the "
+        "transfer audit (analysis/jaxcheck.py JX003) and the compile "
+        "counter all cannot attribute. Build/load-time placements are "
+        "allowlisted with a reason (ISSUE 12)"),
+    "DML013": (
+        "Python scalar literal at a jitted call site (weak-type "
+        "cache-key split)",
+        "a bare int/float literal passed to a jitted function traces "
+        "WEAK-TYPED: the same call later made with a committed array "
+        "or np scalar compiles a SECOND program for the same logical "
+        "shape — a silent jit cache-key split the compile counter "
+        "attributes to nothing (jaxcheck JX004 is the abstract-pass "
+        "sibling; DML011 covers the static-arg shapes). Pass arrays/"
+        "np scalars, or make the argument static (ISSUE 12)"),
+    "DML014": (
+        "failpoint declared but exercised by no test or chaos spec",
+        "untested failure handling is indistinguishable from none "
+        "(PR 5's own rule): every faults.KNOWN_FAILPOINTS name must "
+        "be exercised by at least one test or named in a chaos spec "
+        "string somewhere in the repo — a dead name is either a "
+        "coverage hole a chaos drill silently skips, or a stale "
+        "weave. Coverage asserted as a static cross-check over the "
+        "whole repo (ISSUE 12)"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -634,6 +664,203 @@ def _check_dml011(tree: ast.AST, rel: str, findings: list) -> None:
                             "call; pass a tuple/frozen value"))
 
 
+def _check_dml012(tree: ast.AST, rel: str, findings: list) -> None:
+    """Implicit host->device conversions in serve/ outside the engine
+    staging path: jnp.array/jnp.asarray (host data -> device array on
+    the spot) and jax.device_put (device placement belongs to the
+    engine's staging discipline). np.asarray is host-side and free."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)):
+            continue
+        base, attr = node.func.value.id, node.func.attr
+        if (base == "jnp" and attr in ("array", "asarray")) \
+                or (base == "jax" and attr == "device_put"):
+            findings.append(Finding(
+                rel, node.lineno, "DML012",
+                f"{base}.{attr}() in serve/ outside engine.py/"
+                "quantize.py — an implicit host->device transfer "
+                "bypassing the engine's pooled staging + device_put "
+                "path (allowlist build/load-time placements with a "
+                "reason)"))
+
+
+def _check_dml013(tree: ast.AST, rel: str, findings: list) -> None:
+    """Bare numeric literals reaching jitted call sites as traced
+    (non-static) arguments — the weak-type cache-key split. Covers
+    names bound from jax.jit (`f = jax.jit(...)`; `self._forward =
+    jax.jit(...)`) and their local call sites."""
+
+    def _jit_call(value) -> bool:
+        return (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "jit"
+                and isinstance(value.func.value, ast.Name)
+                and value.func.value.id == "jax")
+
+    def _statics(call: ast.Call) -> tuple:
+        by_name: set = set()
+        by_num: set = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                by_name = {c.value for c in ast.walk(kw.value)
+                           if isinstance(c, ast.Constant)
+                           and isinstance(c.value, str)}
+            elif kw.arg == "static_argnums":
+                by_num = {c.value for c in ast.walk(kw.value)
+                          if isinstance(c, ast.Constant)
+                          and isinstance(c.value, int)}
+        return by_name, by_num
+
+    def _params(fn_node) -> Optional[list]:
+        """Positional parameter names of a wrapped def/lambda, or None
+        when the wrapped object's signature is not locally visible."""
+        if fn_node is None:
+            return None
+        a = fn_node.args
+        return [p.arg for p in (a.posonlyargs + a.args)]
+
+    # Local defs (module- and class-level) by name, for resolving
+    # static_argnames back to positions at positional call sites.
+    defs: dict = {n.name: n for n in ast.walk(tree)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))}
+
+    jitted: dict = {}     # bound name/attr -> (by_name, by_num, params)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _jit_call(node.value):
+            by_name, by_num = _statics(node.value)
+            wrapped = node.value.args[0] if node.value.args else None
+            if isinstance(wrapped, ast.Lambda):
+                params = _params(wrapped)
+            elif isinstance(wrapped, ast.Name):
+                params = _params(defs.get(wrapped.id))
+            else:
+                params = None
+            statics = (by_name, by_num, params)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    jitted[t.id] = statics
+                elif isinstance(t, ast.Attribute):
+                    jitted[t.attr] = statics
+    if not jitted:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name) and func.id in jitted:
+            name = func.id
+        elif isinstance(func, ast.Attribute) and func.attr in jitted:
+            name = func.attr
+        if name is None:
+            continue
+        by_name, by_num, params = jitted[name]
+        for i, arg in enumerate(node.args):
+            if i in by_num:
+                continue        # static: hashed, not traced
+            if params is not None and i < len(params) \
+                    and params[i] in by_name:
+                continue        # static by NAME at a positional site —
+                #   jax resolves static_argnames via the signature
+            if params is None and by_name:
+                continue        # signature not visible: a positional
+                #   arg MAY be a static_argnames param — stay quiet
+                #   rather than fail the gate on correct code
+            if (isinstance(arg, ast.Constant)
+                    and type(arg.value) in (int, float)):
+                findings.append(Finding(
+                    rel, node.lineno, "DML013",
+                    f"bare {type(arg.value).__name__} literal "
+                    f"{arg.value!r} passed to jitted {name}() traces "
+                    "weak-typed — a second cache entry vs the "
+                    "committed-array spelling of the same call; pass "
+                    "an array/np scalar or make the arg static"))
+        for kw in node.keywords:
+            if kw.arg in by_name or kw.arg is None:
+                continue
+            if (isinstance(kw.value, ast.Constant)
+                    and type(kw.value.value) in (int, float)):
+                findings.append(Finding(
+                    rel, node.lineno, "DML013",
+                    f"bare {type(kw.value.value).__name__} literal "
+                    f"{kw.value.value!r} passed to jitted {name}() "
+                    f"as {kw.arg}= traces weak-typed — a second cache "
+                    "entry vs the committed-array spelling; pass an "
+                    "array/np scalar or make the arg static"))
+
+
+_FAULTS_REL = "distributedmnist_tpu/serve/faults.py"
+_LINT_SELFTEST_REL = "tests/test_analysis_lint.py"
+
+
+def check_failpoint_coverage(texts: dict) -> list:
+    """DML014, the project-level cross-check: every name declared in
+    faults.KNOWN_FAILPOINTS must be EXERCISED — referenced by a test
+    (exact-name string constant or spec string in tests/) or named in
+    a spec-shaped chaos-schedule string anywhere in the repo (the
+    bench's programmatic schedules count; f-string fragments are
+    scanned piece by piece). `texts` maps repo-relative posix paths to
+    file contents; findings anchor at the declaration line in
+    faults.py."""
+    faults_text = texts.get(_FAULTS_REL)
+    if faults_text is None:
+        return []
+    try:
+        faults_tree = ast.parse(faults_text)
+    except SyntaxError:
+        return []               # DML000 already reported by lint_source
+    declared: list = []         # (name, lineno), declaration order
+    for node in ast.walk(faults_tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "KNOWN_FAILPOINTS"
+                        for t in node.targets)):
+            for c in ast.walk(node.value):
+                if (isinstance(c, ast.Constant)
+                        and isinstance(c.value, str)
+                        and _FAILPOINT_NAME_RE.match(c.value)):
+                    declared.append((c.value, c.lineno))
+    if not declared:
+        return []
+    known = {n for n, _ in declared}
+    exercised: set = set()
+    for rel, text in texts.items():
+        if rel in (_FAULTS_REL, _LINT_SELFTEST_REL):
+            # the weave/declaration is not coverage — and neither are
+            # the lint suite's OWN fixtures, which must spell real
+            # failpoint names to keep DML003 quiet: counting them
+            # would mask DML014 for exactly those names forever
+            continue
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        in_tests = rel.startswith("tests/")
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            s = node.value.strip()
+            if in_tests and s in known:
+                exercised.add(s)
+            if _SPEC_SHAPED_RE.match(s):
+                exercised.update(n for n in _spec_segment_names(s)
+                                 if n in known)
+    findings = []
+    for name, lineno in declared:
+        if name not in exercised:
+            findings.append(Finding(
+                _FAULTS_REL, lineno, "DML014",
+                f"failpoint {name!r} is declared in KNOWN_FAILPOINTS "
+                "but exercised by no test and named in no chaos spec "
+                "— its failure path is untested (add a test/spec, or "
+                "remove the stale weave)"))
+    return findings
+
+
 def _dml009_scope(rel: str) -> bool:
     return _primitive_scope(rel)
 
@@ -643,6 +870,18 @@ def _dml010_scope(rel: str) -> bool:
 
 
 def _dml011_scope(rel: str) -> bool:
+    return _thread_scope(rel)
+
+
+def _dml012_scope(rel: str) -> bool:
+    # engine.py IS the staging path; quantize.py is build-time weight
+    # preparation the engine device_puts as a whole.
+    return (_in_serve_pkg(rel)
+            and os.path.basename(rel) not in ("engine.py",
+                                              "quantize.py"))
+
+
+def _dml013_scope(rel: str) -> bool:
     return _thread_scope(rel)
 
 
@@ -890,6 +1129,13 @@ def lint_source(text: str, rel: str) -> list:
     # DML011: jit-cache-key hazards in serving/bench code.
     if _dml011_scope(rel):
         _check_dml011(tree, rel, findings)
+    # DML012/DML013: the compile-surface siblings (ISSUE 12) — implicit
+    # host->device conversions off the staging path, weak-type literals
+    # at jitted call sites. DML014 is project-level (lint_paths).
+    if _dml012_scope(rel):
+        _check_dml012(tree, rel, findings)
+    if _dml013_scope(rel):
+        _check_dml013(tree, rel, findings)
     return findings
 
 
@@ -915,13 +1161,6 @@ def apply_allowlist(findings: list, lines: list) -> tuple:
     return active, allowed
 
 
-def lint_file(path: str, rel: str) -> tuple:
-    with open(path, "r", encoding="utf-8") as fh:
-        text = fh.read()
-    findings = lint_source(text, rel)
-    return apply_allowlist(findings, text.splitlines())
-
-
 def iter_python_files(root: str) -> Iterable[tuple]:
     """(abs_path, rel_posix) for every lintable .py under the repo:
     the package, tests, scripts, and the top-level entry points."""
@@ -944,8 +1183,23 @@ def iter_python_files(root: str) -> Iterable[tuple]:
 def lint_paths(root: str) -> tuple:
     active: list = []
     allowed: list = []
+    texts: dict = {}
     for path, rel in iter_python_files(root):
-        a, ok = lint_file(path, rel)
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        texts[rel] = text
+        a, ok = apply_allowlist(lint_source(text, rel),
+                                text.splitlines())
+        active.extend(a)
+        allowed.extend(ok)
+    # DML014 needs the WHOLE repo's texts (a failpoint is covered by a
+    # test or spec in some OTHER file) — run it once, after the
+    # per-file pass, and put its findings through the same allowlist
+    # against faults.py's own lines.
+    d14 = check_failpoint_coverage(texts)
+    if d14:
+        a, ok = apply_allowlist(
+            d14, texts.get(_FAULTS_REL, "").splitlines())
         active.extend(a)
         allowed.extend(ok)
     return active, allowed

@@ -348,6 +348,13 @@ class CacheFront:
                                             t0=t0)
                            if tr is not None else None)
                     fut.trace_id = tid
+                    # Collapsed-follower marker: harnesses that audit
+                    # per-request outcomes (the chaos leg's poison-
+                    # isolation ledger, ISSUE 12) must be able to tell
+                    # a leader's failure from its followers' echoes of
+                    # the same error — one injected fault, one rid,
+                    # N futures.
+                    fut.collapsed = True
                     follower = _Follower(rid, tid, fut, t0, n)
                     flight.followers.append(follower)
                     # span recorded UNDER the lock, like the trace
@@ -395,6 +402,7 @@ class CacheFront:
         fut: Future = Future()
         fut.trace_id = tid
         fut.version = entry.version
+        fut.cache_hit = True        # outcome-audit marker (chaos leg)
         fut.set_result(np.array(entry.logits))
         return fut
 

@@ -276,6 +276,7 @@ class EngineFactory:
 
         params = self.model.init(jax.random.PRNGKey(seed),
                                  jnp.zeros((1, 28, 28, 1)))["params"]
+        # lint: allow[DML012] build-time param placement on the admin path, never per-request
         return jax.device_put(params, replicated(self.mesh))
 
     def abstract_params(self):

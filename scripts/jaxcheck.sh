@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# The long-form compile-surface audit (ISSUE 12): the same static
+# auditor scripts/tier1.sh gates on — static jit-cache-key universe
+# closure (warmed == reachable for every dtype variant of both
+# models), transfer/weak-type hazard scans, jaxpr fingerprints vs the
+# committed snapshot — run with the ANALYSIS_r*.json round artifact
+# emitted (BENCH-style numbering), so compile-surface coverage has a
+# trajectory like perf and the explorer do.
+#
+#   bash scripts/jaxcheck.sh                  # audit + artifact
+#   bash scripts/jaxcheck.sh --models mlp     # one model
+#   bash scripts/jaxcheck.sh --update-snapshots --reason "why"
+#                                             # after an INTENDED
+#                                             # forward change
+#   bash scripts/jaxcheck.sh --list-rules     # the JX rule table
+#
+# Exit 0 on a CLOSED clean surface, 1 on findings, 2 on internal
+# error — the lint/explorer exit contract.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m distributedmnist_tpu.analysis.jaxcheck \
+    --emit "$@"

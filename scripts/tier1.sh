@@ -28,4 +28,12 @@ bash scripts/lint.sh || exit $?
 # finding (the summary prints a replay seed). scripts/explore.sh runs
 # the 500-schedule long budget.
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m distributedmnist_tpu.analysis.explore --smoke || exit $?
+# The static compile-surface auditor (ISSUE 12): abstract-evaluate
+# every forward the serving registry could dispatch and prove the jit
+# cache-key universe CLOSED (warmed == reachable), transfer-clean,
+# weak-type-free, and fingerprint-stable against the committed
+# snapshot — before pytest spends a second. CPU-only, no device work,
+# ~15 s. Exit 1 on findings; regenerate snapshots (with a reason) via
+# scripts/jaxcheck.sh after an INTENDED forward change.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m distributedmnist_tpu.analysis.jaxcheck || exit $?
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

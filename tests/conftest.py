@@ -63,6 +63,14 @@ def pytest_configure(config):
         "scripts/explore.sh runs the long-budget sweep")
     config.addinivalue_line(
         "markers",
+        "jaxcheck: static compile-surface auditor test "
+        "(analysis/jaxcheck.py: cache-key universe closure, transfer/"
+        "weak-type hazard scans, jaxpr fingerprint snapshots); "
+        "abstract tracing only — no device work — so it runs in "
+        "tier-1; `-m jaxcheck` selects just this suite "
+        "(scripts/tier1.sh also runs the CLI gate itself after lint)")
+    config.addinivalue_line(
+        "markers",
         "cache: prediction-cache / request-dedup test (serve/cache.py: "
         "the content-hash LRU front layer, single-flight collapse, "
         "invalidation-race coverage, the batcher's intra-batch dedup); "
@@ -92,6 +100,11 @@ def pytest_configure(config):
     # would litter the repo root with one artifact per test. The env
     # opt-in is for serve.py runs; the suite never emits.
     os.environ.pop("DMNIST_ANALYSIS_ARTIFACT", None)
+    # And the jaxcheck sibling (ISSUE 12): DMNIST_JAXCHECK_ARTIFACT=1
+    # makes the auditor CLI emit a round artifact — the test suite
+    # spawns that CLI as a subprocess (worker_env inherits os.environ),
+    # so the opt-in must not leak in and litter the repo root.
+    os.environ.pop("DMNIST_JAXCHECK_ARTIFACT", None)
 
 
 def committed_steps(ckpt_dir: str) -> list:

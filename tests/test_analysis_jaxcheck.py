@@ -1,0 +1,325 @@
+"""analysis/jaxcheck.py: the static compile-surface auditor (ISSUE 12).
+
+Covers the four hazard classes with planted instances asserting the
+exact rule ID (a reachable-but-unwarmed bucket -> JX001, a dead warmup
+rung -> JX002, a host-array leak into a jitted forward -> JX003, a
+weak-type scalar at the jitted boundary -> JX004), jaxpr-fingerprint
+stability (same config twice -> identical; bucket-rung, dtype and a
+planted forward edit each distinct, with the changed component named),
+the snapshot gate (JX005), the CLI exit contract, and the
+repo-at-HEAD gate itself: the committed audit surface must be CLOSED.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.analysis import jaxcheck as jc
+from tests.conftest import worker_env
+
+pytestmark = [pytest.mark.analysis, pytest.mark.jaxcheck]
+
+
+def small_target(**kw):
+    kw.setdefault("model", "mlp")
+    kw.setdefault("serve_max_batch", 8)
+    return jc.AuditTarget(**kw)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- the closed surface at HEAD --------------------------------------------
+
+
+def test_small_audit_is_closed():
+    """A well-formed deployment shape audits CLOSED: every reachable
+    key warmed, every warmed key reachable, no transfer or weak-type
+    findings, one fingerprint per (dtype, bucket)."""
+    r = jc.audit_target(small_target())
+    assert r["findings"] == []
+    assert r["static_keys"] == r["warmed_keys"] > 0
+    dtypes = set(r["infer_dtypes"])
+    assert dtypes == {"float32", "bfloat16", "int8"}   # the auto universe
+    assert len(r["fingerprints"]) == len(dtypes) * len(r["buckets"])
+    assert all(len(fp) == 16 for fp in r["fingerprints"].values())
+
+
+def test_explicit_dtype_narrows_the_universe():
+    r = jc.audit_target(small_target(serve_infer_dtype="int8"))
+    assert set(r["infer_dtypes"]) == {"float32", "int8"}
+    r = jc.audit_target(small_target(serve_infer_dtype="float32"))
+    assert set(r["infer_dtypes"]) == {"float32"}
+
+
+# -- fingerprint stability (ISSUE 12 satellite) ----------------------------
+
+
+def test_same_config_twice_identical_fingerprints():
+    a = jc.audit_target(small_target())
+    b = jc.audit_target(small_target())
+    assert a["fingerprints"] == b["fingerprints"]
+    assert jc.diff_fingerprints(a["fingerprints"],
+                                b["fingerprints"]) == []
+    assert (jc.fingerprint_set_hash(a["fingerprints"])
+            == jc.fingerprint_set_hash(b["fingerprints"]))
+
+
+def test_bucket_rung_change_distinct_and_named():
+    a = jc.audit_target(small_target(buckets=(4, 8)))
+    b = jc.audit_target(small_target(buckets=(4, 16),
+                                     serve_max_batch=16))
+    diff = jc.diff_fingerprints(a["fingerprints"], b["fingerprints"])
+    assert diff and all(f.rule == "JX005" for f in diff)
+    named = [f for f in diff if "in bucket" in f.message]
+    assert named, [f.message for f in diff]
+    # the shared rung's fingerprint is bucket-independent only per key:
+    # b4 exists in both tables and must agree
+    shared = [k for k in a["fingerprints"] if k in b["fingerprints"]]
+    assert shared
+    assert all(a["fingerprints"][k] == b["fingerprints"][k]
+               for k in shared)
+
+
+def test_dtype_change_distinct_and_named():
+    r = jc.audit_target(small_target(buckets=(4,), serve_max_batch=4))
+    fps = r["fingerprints"]
+    k_f32 = jc.key_str("mlp", "float32", r["fused_mode"], 4)
+    k_int8 = jc.key_str("mlp", "int8", r["fused_mode"], 4)
+    assert fps[k_f32] != fps[k_int8]
+    diff = jc.diff_fingerprints({k_f32: fps[k_f32]},
+                                {k_int8: fps[k_int8]})
+    assert any("in infer_dtype" in f.message for f in diff), \
+        [f.message for f in diff]
+
+
+def test_planted_forward_edit_changes_fingerprint():
+    """An edited forward (same shapes, different graph) produces a
+    distinct fingerprint, and the snapshot diff names the key as a
+    changed GRAPH, not a changed key component."""
+    model = jc._build_model("mlp", "float32", "auto")
+    shapes = jc.abstract_params(model)
+    fn, avals = jc.abstract_forward(model, "float32", "xla", shapes)
+
+    def edited(p, x_u8):
+        return fn(p, x_u8) * 2.0          # the planted graph edit
+
+    key = jc.key_str("mlp", "float32", "xla", 8)
+    fp0, f0 = jc.audit_forward(fn, avals, 8, key)
+    fp1, f1 = jc.audit_forward(edited, avals, 8, key)
+    assert f0 == [] and f1 == []
+    assert fp0 != fp1
+    diff = jc.diff_fingerprints({key: fp1}, {key: fp0})
+    assert len(diff) == 1 and diff[0].rule == "JX005"
+    assert "compiled graph changed" in diff[0].message
+
+
+# -- planted hazards, each named by its rule ID ----------------------------
+
+
+def test_planted_unwarmed_reachable_bucket_jx001(monkeypatch):
+    """A warmup regression that skips the top rung is a
+    reachable-but-unwarmed key: JX001, naming the cold bucket."""
+    real = jc.warmed_buckets
+    monkeypatch.setattr(
+        jc, "warmed_buckets",
+        lambda buckets, dt: real(buckets, dt) - {max(buckets)})
+    r = jc.audit_target(small_target())
+    assert _rules(r["findings"]) == ["JX001"]
+    top = max(r["buckets"])
+    assert all(f.key.endswith(f"/b{top}") for f in r["findings"])
+    assert "steady-state" in r["findings"][0].message
+
+
+def test_planted_unreachable_warmed_bucket_jx002():
+    """An explicit ladder with a rung past any admissible request size
+    is dead warmup cost: JX002, naming the dead bucket."""
+    r = jc.audit_target(small_target(buckets=(4, 8, 64)))
+    assert _rules(r["findings"]) == ["JX002"]
+    assert all(f.key.endswith("/b64") for f in r["findings"])
+    assert "dead warmup cost" in r["findings"][0].message
+
+
+def test_planted_host_array_leak_jx003():
+    """A forward closing over a host ndarray (instead of taking it as
+    a staged argument) is caught as a jaxpr const: JX003."""
+    model = jc._build_model("mlp", "float32", "auto")
+    shapes = jc.abstract_params(model)
+    fn, avals = jc.abstract_forward(model, "float32", "xla", shapes)
+    leak = np.ones((1, 10), np.float32)
+
+    def leaky(p, x_u8):
+        return fn(p, x_u8) + leak         # the planted host-array leak
+
+    key = jc.key_str("mlp", "float32", "xla", 4)
+    _, findings = jc.audit_forward(leaky, avals, 4, key)
+    assert _rules(findings) == ["JX003"]
+    assert "host" in findings[0].message
+    assert "(1, 10)" in findings[0].message
+
+
+def test_planted_weak_type_literal_jx004():
+    """A Python scalar reaching the jitted boundary as a traced
+    argument is a weak-typed aval: JX004."""
+    import jax
+
+    def scaled(p, x_u8):
+        return x_u8.astype("float32") * p["scale"]
+
+    key = jc.key_str("mlp", "float32", "xla", 4)
+    _, findings = jc.audit_forward(
+        scaled, {"scale": 0.5}, 4, key)   # the planted scalar literal
+    assert "JX004" in _rules(findings)
+    assert any("WEAK-TYPED" in f.message for f in findings)
+    # the committed-array spelling of the same forward is clean
+    aval = jax.ShapeDtypeStruct((), np.float32)
+    _, clean = jc.audit_forward(scaled, {"scale": aval}, 4, key)
+    assert clean == []
+
+
+# -- the snapshot gate -----------------------------------------------------
+
+
+def test_snapshot_roundtrip_and_drift(tmp_path):
+    import jax
+
+    r = jc.audit_target(small_target(buckets=(4,), serve_max_batch=4))
+    path = str(tmp_path / "snap.json")
+    jc.write_snapshot({"t": r["fingerprints"]}, "unit test", path=path)
+    snap = jc.load_snapshot(path)
+    assert snap["reason"] == "unit test"
+    assert snap["jax_version"] == jax.__version__
+    assert jc.diff_fingerprints(r["fingerprints"],
+                                snap["fingerprints"]["t"]) == []
+    # planted drift: one fingerprint flipped -> JX005 on exactly it
+    drifted = dict(r["fingerprints"])
+    k = sorted(drifted)[0]
+    drifted[k] = "0" * 16
+    diff = jc.diff_fingerprints(drifted, snap["fingerprints"]["t"])
+    assert len(diff) == 1
+    assert diff[0].rule == "JX005" and diff[0].key == k
+
+
+def test_missing_snapshot_is_a_warning_not_a_finding(tmp_path):
+    assert jc.load_snapshot(str(tmp_path / "absent.json")) is None
+
+
+def test_partial_audit_skips_unaudited_snapshot_labels(tmp_path):
+    """A narrowed audit (--models subset / --no-train) gates the
+    labels it covers but must NOT read the snapshot's other labels as
+    removed keys — only the full default audit may declare a snapshot
+    label dead."""
+    target = small_target(buckets=(4,), serve_max_batch=4)
+    r = jc.audit_target(target)
+    path = str(tmp_path / "snap.json")
+    jc.write_snapshot({target.label(): r["fingerprints"],
+                       "ghost-target": {"ghost/f32/xla/b4": "f" * 16}},
+                      "seed", path=path)
+    full = jc.run_audit([target], with_train=False,
+                        snapshot_file=path, partial=False)
+    assert any(f.rule == "JX005" and "ghost" in f.key
+               for f in full["findings"])
+    part = jc.run_audit([target], with_train=False,
+                        snapshot_file=path, partial=True)
+    assert part["findings"] == []
+
+
+def test_update_snapshots_partial_merges(tmp_path, monkeypatch):
+    """--update-snapshots from a narrowed audit merges into the
+    committed snapshot instead of silently dropping every label the
+    audit never produced (which would break the next full gate run)."""
+    path = str(tmp_path / "snap.json")
+    jc.write_snapshot({"lenet-keep": {"k": "a" * 16}}, "seed",
+                      path=path)
+    monkeypatch.setattr(jc, "snapshot_path", lambda: path)
+    rc = jc.main(["--models", "mlp", "--no-train",
+                  "--update-snapshots", "--reason", "partial test"])
+    assert rc == 0
+    snap = jc.load_snapshot(path)
+    assert "lenet-keep" in snap["fingerprints"]       # preserved
+    assert any(lbl.startswith("mlp-") for lbl in snap["fingerprints"])
+    assert snap["reason"] == "partial test"
+
+
+def test_update_snapshots_partial_refuses_cross_version(tmp_path,
+                                                        monkeypatch):
+    """A partial merge over a snapshot written under a DIFFERENT jax
+    version would stamp the new version while the unaudited labels
+    still carry the old version's jaxpr printing — re-arming the JX005
+    gate against exactly the drift the version check excuses. Refused
+    (exit 2), snapshot untouched; a full --update-snapshots is the
+    documented path."""
+    import json
+
+    path = str(tmp_path / "snap.json")
+    jc.write_snapshot({"lenet-keep": {"k": "a" * 16}}, "seed",
+                      path=path)
+    snap = json.load(open(path))
+    snap["jax_version"] = "0.0.0"
+    json.dump(snap, open(path, "w"))
+    monkeypatch.setattr(jc, "snapshot_path", lambda: path)
+    rc = jc.main(["--models", "mlp", "--no-train",
+                  "--update-snapshots", "--reason", "x"])
+    assert rc == 2
+    assert jc.load_snapshot(path)["jax_version"] == "0.0.0"  # untouched
+
+
+# -- compile-surface provenance (the bench block) --------------------------
+
+
+def test_compile_surface_summary_stable_and_geometry_sensitive():
+    a = jc.compile_surface_summary("mlp", (4, 8), 8, "float32")
+    b = jc.compile_surface_summary("mlp", (4, 8), 8, "float32")
+    assert a["static_keys"] == 2 and a["findings"] == 0
+    assert a["fingerprint_set_hash"] == b["fingerprint_set_hash"]
+    c = jc.compile_surface_summary("mlp", (4, 8, 16), 16, "float32")
+    assert c["static_keys"] == 3
+    assert c["fingerprint_set_hash"] != a["fingerprint_set_hash"]
+    d = jc.compile_surface_summary("mlp", (4, 8), 8, "int8")
+    assert d["static_keys"] == 4          # f32 base + the int8 variant
+    assert d["fingerprint_set_hash"] != a["fingerprint_set_hash"]
+
+
+# -- CLI exit contract + the repo-at-HEAD gate -----------------------------
+
+
+def _run_cli(extra, timeout=300):
+    env, repo = worker_env()
+    return subprocess.run(
+        [sys.executable, "-m", "distributedmnist_tpu.analysis.jaxcheck"]
+        + extra,
+        capture_output=True, text=True, env=env, cwd=repo,
+        timeout=timeout)
+
+
+def test_cli_usage_errors_exit_2():
+    out = _run_cli(["--models", "resnet"])
+    assert out.returncode == 2
+    assert "unknown model" in out.stderr
+    out = _run_cli(["--update-snapshots"])    # no --reason
+    assert out.returncode == 2
+    assert "--reason" in out.stderr
+
+
+def test_cli_list_rules():
+    out = _run_cli(["--list-rules"])
+    assert out.returncode == 0
+    for rule in ("JX001", "JX002", "JX003", "JX004", "JX005"):
+        assert rule in out.stdout
+
+
+def test_repo_at_head_audits_closed():
+    """The acceptance criterion scripts/tier1.sh enforces: at HEAD the
+    full default audit reports a CLOSED compile surface — static key
+    universe == warmed key set for every dtype variant of both models,
+    zero transfer/weak-type findings, fingerprints matching the
+    committed snapshot — and exits 0."""
+    out = _run_cli([])
+    assert out.returncode == 0, (out.stdout + "\n" + out.stderr)[-3000:]
+    assert "CLOSED, 0 findings" in out.stderr
+    assert "no fingerprint snapshot" not in out.stderr, \
+        "the snapshot must be committed for the gate to be armed"

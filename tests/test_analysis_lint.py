@@ -501,7 +501,7 @@ def test_dml011_mutable_literal_at_jitted_callsite():
            "def f(x, buckets=(1, 2)):\n"
            "    return x\n"
            "g = jax.jit(f, static_argnames=('buckets',))\n"
-           "y = g(1, buckets=[1, 2])\n")
+           "y = g(x, buckets=[1, 2])\n")
     rules = _rules(src, "distributedmnist_tpu/serve/engine.py")
     assert rules == ["DML011"]
     f = [x for x in lint.lint_source(
@@ -514,9 +514,187 @@ def test_dml011_hashable_statics_clean():
            "def f(x, buckets=(1, 2)):\n"
            "    return x\n"
            "g = jax.jit(f, static_argnames=('buckets',))\n"
-           "y = g(1, buckets=(1, 2))\n"
+           "y = g(x, buckets=(1, 2))\n"
            "h = jax.jit(f, donate_argnums=1)\n")
     assert _rules(src, "distributedmnist_tpu/serve/engine.py") == []
+
+
+# -- DML012: implicit host->device conversions (ISSUE 12) ------------------
+
+
+def test_dml012_jnp_conversions_flagged_outside_staging():
+    for call in ("jnp.asarray(rows)", "jnp.array(rows)",
+                 "jax.device_put(rows)"):
+        src = f"import jax\nimport jax.numpy as jnp\nx = {call}\n"
+        assert _rules(src) == ["DML012"], call
+    f = lint.lint_source("import jax.numpy as jnp\n"
+                         "x = jnp.asarray(r)\n", SERVE_REL)[0]
+    assert "staging" in f.message
+
+
+def test_dml012_scope_staging_path_and_host_side_exempt():
+    src = "import jax.numpy as jnp\nx = jnp.asarray(rows)\n"
+    # the engine IS the staging path; quantize.py is build-time prep
+    assert _rules(src, "distributedmnist_tpu/serve/engine.py") == []
+    assert _rules(src, "distributedmnist_tpu/serve/quantize.py") == []
+    # the trainer is not serving code; np.asarray is host-side and free
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+    assert _rules("import numpy as np\nx = np.asarray(rows)\n") == []
+
+
+def test_dml012_pragma_allowlists_build_time_placement():
+    src = ("import jax\n"
+           "# lint: allow[DML012] build-time param placement\n"
+           "p = jax.device_put(params)\n")
+    assert _active_rules(src) == []
+
+
+# -- DML013: weak-type literals at jitted call sites (ISSUE 12) ------------
+
+
+def test_dml013_bare_literal_to_jitted_name():
+    src = ("import jax\n"
+           "g = jax.jit(f)\n"
+           "y = g(x, 3.0)\n")
+    ENGINE = "distributedmnist_tpu/serve/engine.py"
+    assert _rules(src, ENGINE) == ["DML013"]
+    f = lint.lint_source(src, ENGINE)[0]
+    assert f.line == 3 and "weak-typed" in f.message
+    # bench.py is in scope; training code is not
+    assert _rules(src, "bench.py") == ["DML013"]
+    assert _rules(src, "distributedmnist_tpu/trainer.py") == []
+
+
+def test_dml013_jitted_attribute_call_site():
+    src = ("import jax\n"
+           "class E:\n"
+           "    def __init__(self):\n"
+           "        self._forward = jax.jit(f)\n"
+           "    def run(self, p, x):\n"
+           "        return self._forward(p, x, 255)\n")
+    assert _rules(src,
+                  "distributedmnist_tpu/serve/engine.py") == ["DML013"]
+
+
+def test_dml013_static_args_and_arrays_clean():
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "g = jax.jit(f, static_argnums=(1,))\n"
+           "h = jax.jit(f, static_argnames=('k',))\n"
+           "y = g(x, 3)\n"                 # static: hashed, not traced
+           "z = h(x, k=2.5)\n"             # static by name
+           "w = g(x)\n"
+           "v = g(x, np.float32(2.0))\n")  # committed np scalar
+    assert _rules(src, "distributedmnist_tpu/serve/engine.py") == []
+
+
+def test_dml013_only_jitted_names_flagged():
+    assert _rules("y = plain(x, 3.0)\n") == []
+
+
+def test_dml013_static_argnames_resolved_at_positional_site():
+    # jax resolves static_argnames to POSITIONS via the wrapped
+    # signature, so a literal passed positionally into a by-name
+    # static param is hashed, not traced — must stay clean
+    ENGINE = "distributedmnist_tpu/serve/engine.py"
+    src = ("import jax\n"
+           "def f(x, k):\n"
+           "    return x\n"
+           "g = jax.jit(f, static_argnames=('k',))\n"
+           "y = g(x, 3)\n")
+    assert _rules(src, ENGINE) == []
+    # the same signature with the literal in the TRACED slot fires
+    src2 = ("import jax\n"
+            "def f(x, k):\n"
+            "    return x\n"
+            "g = jax.jit(f, static_argnames=('k',))\n"
+            "y = g(3, k)\n")
+    assert _rules(src2, ENGINE) == ["DML013"]
+
+
+def test_dml013_unknown_signature_with_argnames_stays_quiet():
+    # the wrapped signature is not locally visible and static_argnames
+    # exists: a positional literal MAY be the static param, so the
+    # positional site stays quiet (lint must not fail the gate on
+    # correct code) — a non-static KEYWORD literal still fires
+    src = ("import jax\n"
+           "from m import f\n"
+           "g = jax.jit(f, static_argnames=('k',))\n"
+           "y = g(x, 3)\n"
+           "z = g(x, n=4)\n")
+    findings = lint.lint_source(src,
+                                "distributedmnist_tpu/serve/engine.py")
+    assert [f.rule for f in findings] == ["DML013"]
+    assert findings[0].line == 5 and "n=" in findings[0].message
+
+
+# -- DML014: failpoint coverage cross-check (ISSUE 12) ---------------------
+
+FAULTS_REL = "distributedmnist_tpu/serve/faults.py"
+# Synthetic declaration using REAL registry names (the declared set is
+# parsed from THIS text, and real names keep the repo's own DML003
+# spec-literal scan quiet about these fixtures).
+FAULTS_SRC = ("KNOWN_FAILPOINTS = frozenset((\n"
+              "    'engine.dispatch', 'engine.fetch', "
+              "'batch.dispatch'))\n")
+
+
+def test_dml014_uncovered_failpoint_flagged():
+    texts = {FAULTS_REL: FAULTS_SRC,
+             "tests/test_x.py": "POINT = 'engine.dispatch'\n",
+             "bench.py": "spec = 'engine.fetch:p=1,count=2'\n"}
+    findings = lint.check_failpoint_coverage(texts)
+    assert [f.rule for f in findings] == ["DML014"]
+    assert "batch.dispatch" in findings[0].message
+    assert findings[0].path == FAULTS_REL and findings[0].line == 2
+
+
+def test_dml014_weave_site_is_not_coverage():
+    # the failpoint() call in serve/ is the WEAVE, not an exercise —
+    # a name referenced only by its own call site stays uncovered
+    texts = {FAULTS_REL: FAULTS_SRC,
+             "distributedmnist_tpu/serve/x.py":
+                 "failpoint('engine.dispatch')\n"
+                 "failpoint('engine.fetch')\n"
+                 "failpoint('batch.dispatch')\n"}
+    findings = lint.check_failpoint_coverage(texts)
+    assert sorted(f.rule for f in findings) == ["DML014"] * 3
+
+
+def test_dml014_spec_fragments_in_fstrings_count():
+    # the bench's concatenated/f-string chaos schedules cover their
+    # names piece by piece (the chaos_fault_spec shape)
+    texts = {FAULTS_REL: FAULTS_SRC,
+             "bench.py":
+                 "def spec(v):\n"
+                 "    return ('batch.dispatch:mode=request,p=0.1;'\n"
+                 "            f'engine.fetch:p=1,version={v}'\n"
+                 "            f';engine.dispatch:p=1,after={v}')\n"}
+    assert lint.check_failpoint_coverage(texts) == []
+
+
+def test_dml014_clean_when_all_covered():
+    texts = {FAULTS_REL: FAULTS_SRC,
+             "tests/test_a.py": ("a = 'engine.dispatch'\n"
+                                 "b = 'engine.fetch:p=0.5'\n"
+                                 "c = 'batch.dispatch'\n")}
+    assert lint.check_failpoint_coverage(texts) == []
+
+
+def test_dml014_missing_faults_file_is_silent():
+    assert lint.check_failpoint_coverage({"tests/t.py": "x = 1\n"}) == []
+
+
+def test_dml014_lint_selftest_fixtures_are_not_coverage():
+    # THIS file's own fixtures must spell real failpoint names (the
+    # DML003 spec-literal scan forces that) — if they counted as
+    # coverage, DML014 could never fire for exactly those names again
+    texts = {FAULTS_REL: FAULTS_SRC,
+             "tests/test_analysis_lint.py": ("a = 'engine.dispatch'\n"
+                                             "b = 'engine.fetch:p=1'\n"
+                                             "c = 'batch.dispatch'\n")}
+    findings = lint.check_failpoint_coverage(texts)
+    assert sorted(f.rule for f in findings) == ["DML014"] * 3
 
 
 # -- allowlist pragma ------------------------------------------------------

@@ -60,6 +60,13 @@ def test_bench_serve_contract():
     assert host["chip_count"] == d["n_chips"]
     assert host["device_kind"] and host["hostname"] and host["platform"]
     assert d["swap"] is None               # not requested in this run
+    # compile-surface provenance (ISSUE 12): f32 headline = one dtype
+    # over the record's own bucket ladder, with the fingerprint hash
+    cs = d["compile_surface"]
+    assert cs["static_keys"] == len(d["buckets"])
+    assert cs["infer_dtypes"] == ["float32"]
+    assert len(cs["fingerprint_set_hash"]) == 16
+    assert cs["findings"] == 0
     assert d["params"] == "fresh-init"
     assert d["live_version_final"]
     assert d["max_inflight"] == 4          # the bench's pipelined default
@@ -836,6 +843,54 @@ def test_bench_serve_chaos_contract():
     assert c["bisect_rescued_requests"] >= 1
 
 
+@pytest.mark.chaos
+@pytest.mark.cache
+def test_bench_serve_chaos_cache_ledger():
+    """`bench.py serve --chaos --serve-cache` (the ROADMAP follow-up
+    PR 10 left open): the whole chaos drill runs through the
+    prediction cache + single-flight front with the registry's
+    invalidation hook live — and the poison-isolation ledger stays
+    EXACT on a leader basis: client failures from dispatch injection,
+    minus collapsed-follower echoes, equal the injector's distinct
+    poisoned set; cached hits and collapsed followers distort
+    nothing. The forced rollback's epoch bump is exercised mid-storm
+    (>= 1 invalidation), and the resilience acceptance bars all still
+    hold behind the cache front."""
+    out = _run_cli("bench.py", ["serve", "--chaos", "--serve-cache"]
+                   + SERVE_ARGS)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip())
+    c = rec["detail"]["chaos"]
+    cache = c["cache"]
+    assert cache["enabled"] is True and cache["capacity"] == 4096
+    # the ledger (ISSUE 12 satellite acceptance)
+    assert cache["ledger_exact"] is True
+    assert c["poison_isolated_exact"] is True
+    assert (cache["poison_leaders"]
+            == cache["poison_client_failures"]
+            - cache["poison_follower_echoes"]
+            == c["poison_unique"] > 0)
+    # the cache really fronted the drill: the 256-request mix repeats,
+    # so hits happen — and every hit was served ok without a rid draw
+    stats = cache["stats"]
+    assert stats["hits"] >= 1
+    assert cache["cache_hits_ok"] >= 1
+    # the rollback's atomic epoch bump fired mid-storm
+    assert c["rollback_engaged"] is True
+    assert stats["invalidations"] >= 1
+    # resilience bars unchanged behind the front
+    assert c["availability_ok"] is True
+    assert c["other_failures"] == 0
+    assert c["breaker_trips"] == 1
+    assert c["recompiles_during_chaos"] == 0
+
+
+def test_bench_serve_cache_flag_requires_chaos():
+    out = _run_cli("bench.py", ["serve", "--serve-cache"] + SERVE_ARGS)
+    assert out.returncode == 2
+    assert "--chaos" in out.stderr
+
+
 def test_bench_serve_swap_during_load():
     """`bench.py serve --swap-during-load`: the record carries the swap
     block — a real mid-window load + pre-warm + promote with ZERO
@@ -894,3 +949,43 @@ def test_baseline_delta_includes_chaos_leg_rows():
     # a chaos-less round degrades to empty rows, not a KeyError
     delta = bench_mod._baseline_delta(rec(100.0, None), base, "x.json")
     assert delta["chaos_availability"]["current"] is None
+
+
+@pytest.mark.jaxcheck
+def test_baseline_delta_includes_compile_surface_row():
+    """ISSUE 12 satellite: the --baseline delta table carries the
+    compile-surface provenance row (static key count) plus the
+    fingerprint-set hash comparison, degrading to None against
+    pre-ISSUE 12 records."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(worker_env()[1], "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+
+    def rec(value, surface):
+        return {"value": value, "detail": {
+            "closed_loop": {"latency_ms": {"p99": 5.0}},
+            "ragged": None,
+            "recompiles_after_warmup": 0,
+            "chaos": None,
+            "compile_surface": surface,
+            "host": {"device_kind": "cpu"}}}
+
+    cur = rec(100.0, {"static_keys": 10,
+                      "fingerprint_set_hash": "aaaa"})
+    base = rec(90.0, {"static_keys": 8,
+                      "fingerprint_set_hash": "bbbb"})
+    delta = bench_mod._baseline_delta(cur, base, "BENCH_serve_r08.json")
+    assert delta["compile_surface_keys"]["current"] == 10
+    assert delta["compile_surface_keys"]["baseline"] == 8
+    assert delta["compile_surface"]["match"] is False
+    same = bench_mod._baseline_delta(
+        cur, rec(90.0, {"static_keys": 10,
+                        "fingerprint_set_hash": "aaaa"}), "x.json")
+    assert same["compile_surface"]["match"] is True
+    # pre-ISSUE 12 baseline: None rows, no hash verdict, no KeyError
+    old = bench_mod._baseline_delta(cur, rec(90.0, None), "x.json")
+    assert old["compile_surface_keys"]["baseline"] is None
+    assert old["compile_surface"]["match"] is None

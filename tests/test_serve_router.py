@@ -224,6 +224,36 @@ def test_broken_shadow_never_breaks_live_traffic(rng):
         assert metrics.snapshot()["shadow_errors"] >= 3, mode
 
 
+@pytest.mark.chaos
+def test_shadow_failpoint_injection_swallowed(rng):
+    """The router.shadow failpoint (the shadow duplicate's chaos seam,
+    ISSUE 12 coverage cross-check DML014): an injected shadow fault is
+    swallowed and counted exactly like a real broken candidate — every
+    client still gets the live bytes, the shadow engine never
+    dispatches, and shadow_errors records each injection."""
+    from distributedmnist_tpu.serve import faults
+
+    metrics = ServeMetrics()
+    r = _router(metrics=metrics)
+    live, shadow = VersionStubEngine(1.0), VersionStubEngine(5.0)
+    r.set_live(live, "v1")
+    r.set_shadow(shadow, "v2", fraction=1.0)
+    faults.install(faults.FaultInjector.from_spec(
+        "router.shadow:p=1,error=injected shadow outage", seed=7))
+    try:
+        for _ in range(4):
+            out = r.infer(_rows(rng, 2))
+            assert np.all(out == 1.0)
+    finally:
+        faults.uninstall()
+    assert shadow.dispatches == 0      # the fault fired BEFORE dispatch
+    assert metrics.snapshot()["shadow_errors"] == 4
+    # with the injector gone the same shadow serves comparisons again
+    r.infer(_rows(rng, 2))
+    r.drain_shadow(10)
+    assert shadow.dispatches == 1
+
+
 def test_slow_shadow_does_not_stall_live_fanout(rng):
     """A shadow candidate wedged in fetch must not delay live results:
     comparisons drain on their own thread, so live futures resolve at
