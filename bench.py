@@ -220,6 +220,16 @@ def main(argv=None) -> int:
                    help="[serve] prediction-cache capacity in entries "
                         "for the --zipf leg and --serve-cache chaos "
                         "drill (default 4096)")
+    p.add_argument("--lowlat", action="store_true", default=None,
+                   help="[serve] add the single-request low-latency "
+                        "leg (ISSUE 14): one closed-loop client of "
+                        "1-row requests through the coalescing path "
+                        "vs the bypass fast lane (and the parity-gated "
+                        "megakernel variant, models that have one) — "
+                        "p50/p99 side by side (bar: p50 >= 1.5x "
+                        "better, p99 no worse), fastpath span "
+                        "attribution >= 0.95 on every over-SLO "
+                        "request, zero recompiles")
     p.add_argument("--dtype-sweep", action="store_true", default=None,
                    help="[serve] add the inference fast-path leg: warm "
                         "+ parity-gate bf16 and int8 variants, then "
@@ -291,6 +301,7 @@ def main(argv=None) -> int:
                    "--serve-infer-dtype": args.serve_infer_dtype,
                    "--zipf": args.zipf,
                    "--zipf-cache-off": args.zipf_cache_off,
+                   "--lowlat": args.lowlat,
                    "--serve-cache": args.serve_cache,
                    "--serve-cache-capacity": args.serve_cache_capacity,
                    "--dtype-sweep": args.dtype_sweep,
@@ -1303,6 +1314,7 @@ def _serve_zipf_leg(router, metrics, factory, make_batcher,
         return {"rows_per_sec": snap["rows_per_sec"],
                 "requests_per_sec": snap["requests_per_sec"],
                 "latency_ms": snap["latency_ms"],
+                "requests": snap["requests"],
                 "batches": snap["batches"],
                 "dispatched_rows": snap["dispatched_rows"],
                 "rejected_requests": snap["rejected_requests"]}
@@ -1379,7 +1391,25 @@ def _serve_zipf_leg(router, metrics, factory, make_batcher,
         "p99_on_ms": on["latency_ms"]["p99"],
         "device_dispatches_off": off["batches"],
         "device_dispatches_on": on["batches"],
-        "device_dispatch_lower": on["batches"] < off["batches"],
+        # The fewer-dispatches bar, NORMALIZED per served request
+        # (ISSUE 14 satellite): the raw absolute comparison flaked
+        # under full-suite load — a starved cache-off phase could
+        # serve so few requests that its absolute dispatch count
+        # undercut the cache-on phase's ~n_keys unique computations.
+        # Dispatches PER REQUEST is load-invariant: the cache's whole
+        # point is that repeats stop costing device dispatches, so the
+        # on-phase rate must sit strictly below the off-phase rate at
+        # any throughput the host manages.
+        "device_dispatches_per_request_off": (
+            round(off["batches"] / off["requests"], 4)
+            if off["requests"] else None),
+        "device_dispatches_per_request_on": (
+            round(on["batches"] / on["requests"], 4)
+            if on["requests"] else None),
+        "device_dispatch_lower": (
+            off["requests"] > 0 and on["requests"] > 0
+            and (on["batches"] / on["requests"])
+            < (off["batches"] / off["requests"])),
         "single_flight_collapsed": stats["collapsed"],
         "parity_probes": parity_probes,
         "parity_ok": parity_ok,
@@ -1391,6 +1421,161 @@ def _serve_zipf_leg(router, metrics, factory, make_batcher,
           f"{off['latency_ms']['p99']} -> {on['latency_ms']['p99']} "
           f"ms, {stats['collapsed']} collapsed, parity "
           f"{'ok' if parity_ok else 'FAILED'} ({parity_probes} probes)")
+    return leg
+
+
+def _serve_lowlat_leg(registry, router, factory, metrics, make_batcher,
+                      compiles, duration: float, max_wait_us: int,
+                      model: str) -> dict:
+    """The single-request low-latency proof leg (ISSUE 14): ONE
+    closed-loop client (1 in flight, qps << capacity by construction)
+    driving 1-row requests through the SAME pipeline twice — first
+    down the ordinary coalescing path (a lone request pays the
+    coalesce wait plus two queue hand-offs), then with the bypass lane
+    on (empty queue + free slot -> dispatch on the caller's thread,
+    device-resident staging when the geometry has it). The headline is
+    the measured p50 ratio (bar >= 1.5x) with p99 no worse; then, when
+    the model has one, the parity-gated whole-net megakernel variant
+    is promoted and the fast phase re-runs on it.
+
+    Attribution is proven, not assumed: a sub-phase re-runs the fast
+    lane under an installed tracer with a microscopic SLO so EVERY
+    request lands in the exemplar ring, and the leg reports the worst
+    attributed fraction across those over-SLO requests (bar >= 0.95 —
+    a lane stage missing its span would show up as residue here). The
+    timed phases stay tracer-off, pricing the production pipeline.
+
+    Recompile accounting: the megakernel variant's warmup compiles are
+    legitimate off-hot-path warmup (returned for the whole-run
+    exclusion, the dtype-sweep precedent); everything else in the leg
+    must run on already-warm programs."""
+    import numpy as np
+
+    from distributedmnist_tpu.serve import trace as trace_lib
+    from distributedmnist_tpu.serve.quantize import variant_supported
+
+    req = np.random.default_rng(11).integers(0, 256, (1, 28, 28, 1),
+                                             dtype=np.uint8)
+    live = registry.live_version()
+    steady_from = compiles.snapshot()
+    variant_warmups = 0
+
+    def keep(snap: dict) -> dict:
+        return {"requests": snap["requests"],
+                "requests_per_sec": snap["requests_per_sec"],
+                "latency_ms": snap["latency_ms"],
+                "fastpath": snap["fastpath"],
+                "staging_ms": snap["staging_ms"],
+                "fetch_ms": snap["fetch_ms"]}
+
+    def phase(tag: str, fastlane: bool) -> dict:
+        b = make_batcher(1, adaptive=False, fastlane=fastlane)
+        try:
+            _mark(f"lowlat closed loop [{tag}]: 1 client x "
+                  f"{duration:.0f}s, 1-row requests, wait "
+                  f"{max_wait_us}us")
+            snap = _serve_closed_loop(b, metrics, [req], 1, duration)
+        finally:
+            b.stop()
+        out = keep(snap)
+        _mark(f"lowlat [{tag}]: p50 {out['latency_ms']['p50']} ms, "
+              f"p99 {out['latency_ms']['p99']} ms, "
+              f"{out['fastpath']['dispatches']} fastpath dispatches "
+              f"over {out['requests']} requests")
+        return out
+
+    batched = phase("batched", fastlane=False)
+    fast = phase("fastlane", fastlane=True)
+
+    mega = None
+    mega_parity = None
+    if variant_supported(model, "megakernel"):
+        _mark("lowlat: warming + gating the megakernel variant")
+        before = compiles.snapshot()
+        vi = registry.add_variant(live, "megakernel")
+        variant_warmups = compiles.snapshot() - before
+        mega_parity = vi.parity
+        registry.promote(live, infer_dtype="megakernel")
+        try:
+            mega = phase("fastlane+megakernel", fastlane=True)
+        finally:
+            # later legs (swap/chaos) price the f32 base as always
+            registry.promote(live, infer_dtype="float32")
+
+    # Attribution sub-phase: a realistic sub-p50 SLO, so the audited
+    # population is genuinely slow requests (the ones whose budget an
+    # operator would chase) and every one of them lands in the
+    # exemplar ring with its stage blame computable.
+    att_slo_ms = 0.5
+    tracer = trace_lib.install(trace_lib.Tracer(capacity=1024,
+                                                sample=1.0,
+                                                slo_ms=att_slo_ms,
+                                                seed=23))
+    b = make_batcher(1, adaptive=False, fastlane=True)
+    try:
+        for _ in range(64):
+            b.submit(req).result(timeout=60)
+        _drain_or_die(b, timeout=60)
+    finally:
+        b.stop()
+        trace_lib.uninstall()
+    fracs = [trace_lib.attribute_stages(tr)["attributed_frac"]
+             for tr in tracer.traces() if tr["over_slo"]]
+    att_min = round(min(fracs), 4) if fracs else None
+    census = _span_census(tracer)
+
+    recompiles = compiles.snapshot() - steady_from - variant_warmups
+    p50_b = batched["latency_ms"]["p50"]
+    p50_f = fast["latency_ms"]["p50"]
+    _cands = [p for p in (p50_f, (mega or {}).get("latency_ms",
+                                                  {}).get("p50"))
+              if p is not None]
+    best = min(_cands) if _cands else None
+    improvement = (round(p50_b / p50_f, 3) if p50_b and p50_f
+                   else None)
+    p99_ok = (fast["latency_ms"]["p99"] is not None
+              and batched["latency_ms"]["p99"] is not None
+              and fast["latency_ms"]["p99"]
+              <= batched["latency_ms"]["p99"])
+    leg = {
+        "clients": 1,
+        "rows_per_request": 1,
+        "duration_s": duration,
+        "coalesce_wait_us": max_wait_us,
+        "batched": batched,
+        "fastlane": fast,
+        "megakernel": mega,
+        "megakernel_parity": mega_parity,
+        # ISSUE 14 acceptance: p50 >= 1.5x better at qps << capacity,
+        # p99 no worse, zero recompiles, >= 0.95 attribution on every
+        # over-SLO request
+        "p50_batched_ms": p50_b,
+        "p50_fastlane_ms": p50_f,
+        "p50_best_ms": best,
+        "p50_improvement_x": improvement,
+        "p50_ok": improvement is not None and improvement >= 1.5,
+        "p99_ok": p99_ok,
+        "fastpath_dispatches": fast["fastpath"]["dispatches"],
+        "fastpath_lane_fraction": fast["fastpath"]["lane_fraction"],
+        "recompiles": recompiles,
+        "recompiles_ok": recompiles == 0,
+        "variant_warmup_compile_events": variant_warmups,
+        "attribution": {
+            "slo_ms": att_slo_ms,
+            "over_slo_requests": len(fracs),
+            "min_attributed_frac": att_min,
+            "fastpath_spans": census["spans"].get("fastpath", 0),
+            "ok": att_min is not None and att_min >= 0.95,
+        },
+    }
+    _mark(f"lowlat: p50 {p50_b} -> {p50_f} ms "
+          f"({improvement}x, bar >= 1.5x), p99 "
+          f"{batched['latency_ms']['p99']} -> "
+          f"{fast['latency_ms']['p99']} ms (no-worse "
+          f"{'ok' if p99_ok else 'FAILED'}), megakernel p50 "
+          f"{(mega or {}).get('latency_ms', {}).get('p50')} ms, "
+          f"attribution min {att_min} over {len(fracs)} over-SLO "
+          f"requests, {recompiles} recompiles")
     return leg
 
 
@@ -1907,6 +2092,14 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
         "zipf_p99_on_ms": (
             (cur_d.get("zipf") or {}).get("p99_on_ms"),
             (base_d.get("zipf") or {}).get("p99_on_ms")),
+        # the fast-lane signals (ISSUE 14): None-vs-None without
+        # --lowlat
+        "lowlat_p50_improvement_x": (
+            (cur_d.get("lowlat") or {}).get("p50_improvement_x"),
+            (base_d.get("lowlat") or {}).get("p50_improvement_x")),
+        "lowlat_p50_fastlane_ms": (
+            (cur_d.get("lowlat") or {}).get("p50_fastlane_ms"),
+            (base_d.get("lowlat") or {}).get("p50_fastlane_ms")),
         # the compile-surface provenance row (ISSUE 12): static key
         # count side by side; the fingerprint-set hash comparison is
         # appended below the table (hashes don't delta as percentages).
@@ -2233,7 +2426,8 @@ def _serve(args) -> int:
     def make_batcher(max_inflight: int, split: bool = True,
                      adaptive: bool = None, wait_us: int = None,
                      resilience=None,
-                     dedup: bool = False) -> DynamicBatcher:
+                     dedup: bool = False,
+                     fastlane: bool = False) -> DynamicBatcher:
         if adaptive is None:
             adaptive = not args.no_adaptive
         return DynamicBatcher(router, max_batch=factory.max_batch,
@@ -2246,7 +2440,7 @@ def _serve(args) -> int:
                               resilience=(default_resilience
                                           if resilience is None
                                           else resilience),
-                              dedup=dedup,
+                              dedup=dedup, fastlane=fastlane,
                               metrics=metrics).start()
 
     # Phase 1 — serial baseline: inflight=1 is the pre-pipeline chain
@@ -2306,6 +2500,19 @@ def _serve(args) -> int:
     ragged = _serve_ragged_leg(router, metrics, factory, make_batcher,
                                pipelined, clients, duration, low_qps,
                                max_wait_us)
+
+    # Phase 3a (optional) — the single-request low-latency leg
+    # (ISSUE 14): one closed-loop client, 1-row requests, coalescing
+    # path vs the bypass fast lane (and the megakernel variant where
+    # the model has one), with the fastpath attribution sub-phase.
+    # Runs on its own batchers; the megakernel variant's warmup
+    # compiles are excluded from the whole-run recompile check below.
+    lowlat_leg = None
+    if args.lowlat:
+        lowlat_leg = _serve_lowlat_leg(registry, router, factory,
+                                       metrics, make_batcher, compiles,
+                                       duration, max_wait_us,
+                                       args.model)
 
     # Phase 3b (optional) — the hot-key leg (ISSUE 10): the SAME
     # Zipf-distributed request mix closed-loop with the prediction
@@ -2471,6 +2678,9 @@ def _serve(args) -> int:
     if dtype_sweep is not None:
         # and for the sweep variants' off-hot-path warmups
         recompiles -= dtype_sweep["variant_warmup_compile_events"]
+    if lowlat_leg is not None:
+        # and for the lowlat leg's megakernel variant warmup
+        recompiles -= lowlat_leg["variant_warmup_compile_events"]
     if recompiles:
         _mark(f"WARNING: {recompiles} compile events after warmup — "
               "steady state was supposed to be shape-stable")
@@ -2541,6 +2751,11 @@ def _serve(args) -> int:
             # control (--zipf-cache-off) records — --baseline refuses
             # deltas across that boundary.
             "zipf": zipf_leg,
+            # The single-request low-latency leg (ISSUE 14; None
+            # without --lowlat): batched-vs-fastlane p50/p99 at one
+            # in-flight client, the megakernel phase + parity verdict,
+            # the fastpath attribution floor, and the lane counters.
+            "lowlat": lowlat_leg,
             "swap": swap,
             "chaos": chaos,
             # The tracing leg (ISSUE 9; None without --trace): the SLO

@@ -210,14 +210,24 @@ def reachable_buckets(buckets: Sequence[int], max_batch: int) -> set:
     bucket_for over sizes 1..max_batch (pad-and-slice admission — the
     batcher caps coalesced drains at max_batch, bisection only ever
     shrinks, and the registry's parity batch is capped at max_batch
-    too, so this image IS the dispatchable set)."""
+    too, so this image IS the dispatchable set). The fast lane's
+    row-staged program (ISSUE 14) is one more reachable key when the
+    geometry has one — represented as the string '<rung>-row' beside
+    the int rungs, and derived from the engine's OWN rule
+    (engine.fast_row_bucket), so the reachable side can never drift
+    from what dispatch_fast actually routes."""
+    from distributedmnist_tpu.serve.engine import fast_row_bucket
+
     ladder = sorted(set(buckets))
-    out = set()
+    out: set = set()
     for n in range(1, max_batch + 1):
         for b in ladder:
             if b >= n:
                 out.add(b)
                 break
+    rb = fast_row_bucket(buckets)
+    if rb is not None:
+        out.add(f"{rb}-row")
     return out
 
 
@@ -249,6 +259,19 @@ class _WarmupProbe:
     def infer(self, x) -> None:
         self.warmed.add(self.bucket_for(x.shape[0]))
 
+    def _warm_fastlane(self, costs=None) -> None:
+        """The probe's record of the real warmup's fast-lane pass
+        (ISSUE 14): warmup calls this unconditionally; which rung (if
+        any) gets a row-staged program comes from the engine's own
+        fast_row_bucket rule, same as the reachable side. (The cost
+        gate only decides whether the route SERVES; the key is
+        compiled either way, which is what the closure audits.)"""
+        from distributedmnist_tpu.serve.engine import fast_row_bucket
+
+        rb = fast_row_bucket(self.buckets)
+        if rb is not None:
+            self.warmed.add(f"{rb}-row")
+
 
 def warmed_buckets(buckets: Sequence[int], infer_dtype: str) -> set:
     """The rungs `InferenceEngine.warmup` actually compiles for one
@@ -270,14 +293,16 @@ def crosscheck_keys(model: str, fused_mode: str, static: dict,
     for dt in sorted(set(static) | set(warmed)):
         reach = static.get(dt, set())
         warm = warmed.get(dt, set())
-        for b in sorted(reach - warm):
+        # key=str: a set may mix int rungs with the fast lane's
+        # '<rung>-row' key (ISSUE 14)
+        for b in sorted(reach - warm, key=str):
             findings.append(Finding(
                 "JX001", key_str(model, dt, fused_mode, b),
                 f"bucket {b} is reachable (requests of <= {max_batch} "
                 "rows can land in it) but warmup never compiles it — "
                 "the first such request pays a steady-state XLA "
                 "compile on the hot path"))
-        for b in sorted(warm - reach):
+        for b in sorted(warm - reach, key=str):
             findings.append(Finding(
                 "JX002", key_str(model, dt, fused_mode, b),
                 f"bucket {b} is warmed but no admissible request size "
@@ -443,6 +468,26 @@ def audit_forward(fn: Callable, params_avals, bucket: int,
     return fingerprint(jaxpr), audit_jaxpr(jaxpr, key)
 
 
+def audit_row_forward(fn: Callable, params_avals, bucket: int,
+                      key: str) -> tuple:
+    """(fingerprint, findings) for the fast lane's row-staged program
+    at one bucket (ISSUE 14): the engine's stage_row graph — write one
+    row into the resident (bucket, 28, 28, 1) buffer on device, run
+    the same forward — traced abstractly like any served forward."""
+    import jax
+
+    buf_aval = jax.ShapeDtypeStruct((bucket, *_IMAGE_SHAPE), np.uint8)
+    row_aval = jax.ShapeDtypeStruct((1, *_IMAGE_SHAPE), np.uint8)
+
+    def row_fn(p, buf, row):
+        staged = jax.lax.dynamic_update_slice(buf, row, (0, 0, 0, 0))
+        return fn(p, staged), staged
+
+    with jax.transfer_guard("disallow"):
+        jaxpr = jax.make_jaxpr(row_fn)(params_avals, buf_aval, row_aval)
+    return fingerprint(jaxpr), audit_jaxpr(jaxpr, key)
+
+
 def fingerprint_set_hash(fps: dict) -> str:
     """One hash over a whole {key: fingerprint} table — the
     compile-surface provenance stamp bench records carry."""
@@ -458,7 +503,9 @@ def audit_target(target: AuditTarget) -> dict:
     bucket) forward, scan it, fingerprint it, and cross-check the
     static key universe against the warmup-derived warmed set."""
     from distributedmnist_tpu.ops import fused as fused_lib
-    from distributedmnist_tpu.serve.engine import make_buckets
+    from distributedmnist_tpu.serve.engine import (fast_row_bucket,
+                                                   make_buckets)
+    from distributedmnist_tpu.serve.quantize import variant_supported
 
     mode = fused_lib.resolve(target.fused_kernels, "cpu")
     buckets = (tuple(sorted(set(target.buckets))) if target.buckets
@@ -466,7 +513,12 @@ def audit_target(target: AuditTarget) -> dict:
     model = _build_model(target.model, target.dtype,
                          target.fused_kernels)
     param_shapes = abstract_params(model)
-    dtypes = dtype_universe(target.serve_infer_dtype)
+    # Per-model support filter (ISSUE 14): the megakernel variant
+    # exists for the MLP only — the registry's auto-activation skips
+    # an unsupported variant, so the audited universe must too (an
+    # engine that can never be BUILT has no compile keys to audit).
+    dtypes = tuple(dt for dt in dtype_universe(target.serve_infer_dtype)
+                   if variant_supported(target.model, dt))
 
     findings: list = []
     fps: dict = {}
@@ -475,6 +527,7 @@ def audit_target(target: AuditTarget) -> dict:
     warmed = {dt: warmed_buckets(buckets, dt) for dt in dtypes}
     findings.extend(crosscheck_keys(target.model, mode, static, warmed,
                                     target.serve_max_batch))
+    row_b = fast_row_bucket(buckets)
     for dt in dtypes:
         fn, avals = abstract_forward(model, dt, mode, param_shapes)
         for b in sorted(set(buckets)):
@@ -489,6 +542,22 @@ def audit_target(target: AuditTarget) -> dict:
                 continue
             fps[k] = fp
             findings.extend(fnd)
+        if row_b is not None:
+            # The fast lane's row-staged program (ISSUE 14): the same
+            # forward behind an on-device dynamic_update_slice stage —
+            # its own jit cache key, audited and fingerprinted like
+            # any bucket rung (engine.py builds the identical graph).
+            k = key_str(target.model, dt, mode, f"{row_b}-row")
+            try:
+                fp, fnd = audit_row_forward(fn, avals, row_b, k)
+                fps[k] = fp
+                findings.extend(fnd)
+            except Exception as e:
+                findings.append(Finding(
+                    "JX003", k,
+                    "abstract trace of the row-staged fast path "
+                    "failed under transfer_guard('disallow'): "
+                    f"{type(e).__name__}: {e}"))
     return {
         "label": target.label(),
         "model": target.model,

@@ -129,6 +129,17 @@ RULES = {
         "attributes to nothing (jaxcheck JX004 is the abstract-pass "
         "sibling; DML011 covers the static-arg shapes). Pass arrays/"
         "np scalars, or make the argument static (ISSUE 12)"),
+    "DML015": (
+        "engine dispatch/infer call in serve/ outside the "
+        "lane-deciding dispatch plumbing",
+        "every dispatch must pass the batcher's lane decision (and the "
+        "router/fleet plumbing under it) so metrics populations, trace "
+        "spans, failpoints and the resilience outcomes are NEVER "
+        "skipped — a direct engine.infer()/dispatch()/dispatch_fast() "
+        "call from any other serve/ module is an invisible request "
+        "path the whole observability/chaos story silently misses "
+        "(ISSUE 14; admin-path uses like the registry's parity gate "
+        "are allowlisted with a reason)"),
     "DML014": (
         "failpoint declared but exercised by no test or chaos spec",
         "untested failure handling is indistinguishable from none "
@@ -686,6 +697,39 @@ def _check_dml012(tree: ast.AST, rel: str, findings: list) -> None:
                 "reason)"))
 
 
+# DML015: the dispatch-plumbing modules where engine dispatch/infer
+# calls are the mechanism itself, not a bypass. Everything else in
+# serve/ must go through the batcher's lane decision.
+_DISPATCH_PLUMBING = frozenset(
+    ("batcher.py", "engine.py", "router.py", "fleet.py"))
+
+
+def _dml015_scope(rel: str) -> bool:
+    return (_in_serve_pkg(rel)
+            and os.path.basename(rel) not in _DISPATCH_PLUMBING)
+
+
+def _check_dml015(tree: ast.AST, rel: str, findings: list) -> None:
+    """Direct engine dispatch surface calls outside the dispatch
+    plumbing (ISSUE 14): `.dispatch()`, `.dispatch_fast()` and
+    `.infer()` attribute calls. The method names are specific enough
+    that any hit in a non-plumbing serve/ module is a request path
+    skipping the lane decision — or an admin-path measurement that
+    must say so via the allowlist pragma."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("dispatch", "dispatch_fast",
+                                       "infer")):
+            findings.append(Finding(
+                rel, node.lineno, "DML015",
+                f".{node.func.attr}() called outside the dispatch "
+                "plumbing (batcher/router/fleet/engine) — all "
+                "dispatches must pass the lane decision so metrics/"
+                "trace/faults are never skipped; allowlist admin-path "
+                "measurements with a reason"))
+
+
 def _check_dml013(tree: ast.AST, rel: str, findings: list) -> None:
     """Bare numeric literals reaching jitted call sites as traced
     (non-static) arguments — the weak-type cache-key split. Covers
@@ -1136,6 +1180,9 @@ def lint_source(text: str, rel: str) -> list:
         _check_dml012(tree, rel, findings)
     if _dml013_scope(rel):
         _check_dml013(tree, rel, findings)
+    # DML015: dispatches outside the lane-deciding plumbing (ISSUE 14).
+    if _dml015_scope(rel):
+        _check_dml015(tree, rel, findings)
     return findings
 
 

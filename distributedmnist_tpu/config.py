@@ -202,6 +202,23 @@ class Config:
     serve_cache: bool = False
     serve_cache_capacity: int = 4096
     serve_dedup: bool = False
+    # Bounded staleness (ISSUE 14 satellite): entries older than
+    # serve_cache_ttl_s (monotonic age) are expired at lookup — an
+    # expired hit counts as a miss and recomputes. None = entries live
+    # until LRU eviction or invalidation (the PR 10 behavior); models
+    # are deterministic so TTLs exist for operational hygiene (bounding
+    # how long any byte can possibly be served), not correctness.
+    serve_cache_ttl_s: Optional[float] = None
+    # Single-request low-latency fast lane (ISSUE 14, serve/batcher.py
+    # + engine.dispatch_fast): a submit that finds the queue empty and
+    # a free in-flight window slot dispatches immediately on the
+    # caller's thread — no coalesce timer, no queue hand-offs — with
+    # device-resident staging for small buckets and fallback to the
+    # coalescing path the moment contention appears. Off by default:
+    # the lane trades a little peak coalescing opportunity for idle
+    # p50, which is a per-deployment choice (the --lowlat bench leg
+    # measures it).
+    serve_fastlane: bool = False
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -358,13 +375,17 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "free healthy sibling replica (first result "
                         "wins)")
     p.add_argument("--serve-infer-dtype",
-                   choices=["float32", "bfloat16", "int8", "auto"],
+                   choices=["float32", "bfloat16", "int8", "megakernel",
+                            "auto"],
                    default=None,
                    help="[serving] inference precision: float32 = the "
                         "training-identical reference forward; "
                         "bfloat16/int8 = the quantized+fused fast path "
                         "(takes traffic only after the zero-compile "
                         "prove-it pass AND the accuracy-parity gate); "
+                        "megakernel = the f32 whole-net fused-inference "
+                        "variant (MLP only, one Pallas call per "
+                        "dispatch, same two gates); "
                         "auto = cheapest parity-passing variant by the "
                         "warmup cost tables")
     p.add_argument("--serve-cache", dest="serve_cache",
@@ -384,6 +405,22 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "coalesced batcher drain into a single "
                         "dispatch (intra-batch dedup — shrinks padded "
                         "buckets on hot-key traffic)")
+    p.add_argument("--serve-cache-ttl-s", type=float, default=None,
+                   help="[serving] bounded staleness for the prediction "
+                        "cache: entries older than this many seconds "
+                        "(monotonic age) expire at lookup — an expired "
+                        "hit counts as a miss and recomputes (default: "
+                        "no TTL; entries live until LRU eviction or a "
+                        "route-change invalidation)")
+    p.add_argument("--serve-fastlane", dest="serve_fastlane",
+                   action="store_true", default=None,
+                   help="[serving] single-request low-latency bypass "
+                        "lane: a submit that finds the queue empty and "
+                        "a free in-flight slot dispatches immediately "
+                        "on the caller's thread (no coalesce timer, no "
+                        "queue hand-offs, device-resident staging for "
+                        "small buckets); contention falls back to the "
+                        "coalescing path")
     p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "

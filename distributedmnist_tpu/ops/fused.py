@@ -201,3 +201,83 @@ def quant_dense_reference(x_q, w_q, scale, b, relu: bool = True):
                               preferred_element_type=jnp.int32)
     out = acc.astype(jnp.float32) * scale + b
     return jnp.maximum(out, 0.0) if relu else out
+
+
+# -- whole-net inference megakernel (ISSUE 14) -----------------------------
+#
+# The per-layer epilogues above still dispatch one fused call PER LAYER;
+# at single-request batch sizes the dispatch overhead of the layer chain
+# dominates the arithmetic. The megakernel runs the ENTIRE MLP forward —
+# relu(x @ w1 + b1) @ w2 + b2 — as ONE Pallas call: both weight
+# matrices live whole in VMEM (784x128 + 128x10 floats, ~400 KB), the
+# hidden activation never leaves VMEM, and the grid blocks over batch
+# rows only (pallas_guide.md playbook: small N padded up to one lane
+# tile, sliced off after). Forward-only like every inference epilogue;
+# serve/quantize.py serves it as the parity-gated `megakernel` variant,
+# interpret mode on CPU tests exactly like the int8 kernel (production
+# CPU serving takes the XLA oracle route — one fused jnp expression XLA
+# fuses well; the compiled-Pallas arm is the TPU route).
+
+
+def _mlp_mega_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref):
+    h = jnp.dot(x_ref[...], w1_ref[...],
+                preferred_element_type=jnp.float32)
+    h = jnp.maximum(h + b1_ref[...].astype(jnp.float32), 0.0)
+    o = jnp.dot(h, w2_ref[...].astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+    o_ref[...] = (o + b2_ref[...].astype(jnp.float32)).astype(
+        o_ref.dtype)
+
+
+def mlp_megakernel(x: jax.Array, w1: jax.Array, b1: jax.Array,
+                   w2: jax.Array, b2: jax.Array,
+                   mode: str = XLA) -> jax.Array:
+    """relu(x @ w1 + b1) @ w2 + b2 in one fused call, on a resolved
+    kernel mode. The XLA arm IS mlp_megakernel_reference — one
+    definition, so the parity oracle can never drift from the
+    production route."""
+    if mode == XLA:
+        return mlp_megakernel_reference(x, w1, b1, w2, b2)
+    if mode not in (PALLAS, PALLAS_INTERPRET):
+        raise ValueError(f"unresolved fused-kernel mode {mode!r}")
+    m, k = x.shape
+    k2, hdim = w1.shape
+    assert k == k2, (x.shape, w1.shape)
+    h2, n = w2.shape
+    assert hdim == h2, (w1.shape, w2.shape)
+    bm = 128 if m >= 128 else m          # batch-row tile
+    # the (tiny) logits dim ALWAYS pads up to one full lane tile so
+    # the second matmul's output block is MXU-shaped (10 -> 128);
+    # sliced off below — unconditional, so the interpret-mode tests
+    # exercise the same padded graph the TPU route compiles
+    bn = 128
+    pad_m = (-m) % bm
+    pad_n = (-n) % bn
+    if pad_m:
+        x = jnp.pad(x, ((0, pad_m), (0, 0)))
+    if pad_n:
+        w2 = jnp.pad(w2, ((0, 0), (0, pad_n)))
+        b2 = jnp.pad(b2, (0, pad_n))
+    mp, np_ = m + pad_m, n + pad_n
+    out = pl.pallas_call(
+        _mlp_mega_kernel,
+        grid=(mp // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((1, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((hdim, np_), lambda i: (0, 0)),
+            pl.BlockSpec((1, np_), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, np_), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        interpret=mode == PALLAS_INTERPRET,
+    )(x, w1, b1.reshape(1, hdim), w2, b2.reshape(1, np_))
+    return out[:m, :n]
+
+
+@jax.jit
+def mlp_megakernel_reference(x, w1, b1, w2, b2):
+    """XLA oracle for the megakernel — the equivalence tests' basis and
+    exactly the XLA-mode implementation (one definition)."""
+    return jnp.maximum(x @ w1 + b1, 0.0) @ w2 + b2

@@ -5,7 +5,9 @@ north star's "serves heavy traffic from millions of users".
               split into dispatch()/fetch() around the async device queue;
               warmup measures a per-bucket cost table
 - batcher.py  dynamic micro-batcher pipelined through a bounded in-flight
-              window, with bounded-queue backpressure
+              window, with bounded-queue backpressure and the
+              single-request bypass fast lane (ISSUE 14: empty queue +
+              free slot -> dispatch on the caller's thread)
 - scheduler.py cost-model batch former (split-vs-pad planning) and the
               Clipper-style AIMD adaptive-coalescing controller
 - metrics.py  latency percentiles / occupancy / qps / pipeline depth,

@@ -64,6 +64,20 @@ bounded LRU response cache with single-flight collapse of concurrent
 identical misses — is the CacheFront layer in serve/cache.py, which
 sits in FRONT of this batcher.
 
+Fast lane (ISSUE 14): with `fastlane=True`, a submit that finds the
+queue EMPTY and a FREE in-flight window slot skips all of the above —
+it dispatches immediately on the caller's thread (the engine's
+device-resident staging route when one fits) and blocks on its own
+fetch, returning an already-resolved future. The lane decision is one
+atomic choice under the queue lock (scheduler.fastlane_eligible + a
+slot try-acquire), so contention of any kind routes the submit down
+the ordinary coalescing path and every drain/stop/shed invariant is
+unchanged; the claimed slot IS the request's in-flight slot, so the
+pipeline-depth bound holds across both lanes. Lone requests stop
+paying the coalesce wait and two thread hand-offs; loaded traffic
+never sees the lane at all (the analysis/harnesses.py
+`batcher-fastlane` machine explores the races).
+
 Tracing (ISSUE 9, serve/trace.py): with a tracer installed, every
 request's path through this pipeline is recorded as a span tree —
 queue wait, the coalesce window, the batch former's plan, dispatch,
@@ -93,6 +107,7 @@ from distributedmnist_tpu.serve import trace
 from distributedmnist_tpu.serve.faults import failpoint
 from distributedmnist_tpu.serve.resilience import DeadlineExceeded
 from distributedmnist_tpu.serve.scheduler import (AdaptiveController,
+                                                  fastlane_eligible,
                                                   plan_segments)
 
 
@@ -141,11 +156,17 @@ class DynamicBatcher:
     """Dispatch + completion threads over a bounded request queue.
 
     start()/stop() manage the threads; submit(x) -> Future resolving to
-    the request's (n, 10) logits. All engine.dispatch() calls happen on
-    the one dispatch thread and all engine.fetch() calls on the one
-    completion thread, in dispatch order — so results can never reorder
-    across batches and the engine needs no locking beyond its staging
-    pool.
+    the request's (n, 10) logits. Coalesced engine.dispatch() calls
+    happen on the one dispatch thread and their engine.fetch() calls on
+    the one completion thread, in dispatch order — results can never
+    reorder across batches. With the fast lane on (ISSUE 14) a
+    bypassing submit additionally dispatches AND fetches its own
+    single-request batch on the caller's thread; the engine's dispatch/
+    fetch are thread-safe for this (the staging pool is locked, the
+    resident fast routes are single-flight, and a fetch is per-handle —
+    the same property the router's shadow drain thread already relies
+    on), and per-request results still cannot reorder: a fast-lane
+    future resolves from exactly its own fetch.
     """
 
     def __init__(self, engine, max_batch: Optional[int] = None,
@@ -154,8 +175,20 @@ class DynamicBatcher:
                  max_inflight: Optional[int] = None,
                  slo_ms: Optional[float] = None, adaptive: bool = True,
                  split: bool = True, resilience=None,
-                 dedup: bool = False):
+                 dedup: bool = False, fastlane: bool = False):
         self.engine = engine
+        # The single-request bypass lane (ISSUE 14): a submit that
+        # finds the queue EMPTY and a FREE in-flight window slot
+        # dispatches immediately on the caller's thread — no coalesce
+        # timer, no dispatch-thread hand-off, no completion-thread
+        # hand-off (the caller blocks on its own fetch). The moment
+        # contention appears (pending rows, or every slot held) the
+        # lane closes and the submit takes the coalescing path, so
+        # batching throughput is untouched under load. The decision is
+        # made under the queue lock (scheduler.fastlane_eligible + a
+        # slot try-acquire), so the drain/stop/shed invariants — and
+        # the PR 11 explored machines — see one atomic choice.
+        self.fastlane = fastlane
         # Intra-batch dedup (ISSUE 10): identical rows inside one
         # coalesced drain dispatch once and fan out, shrinking the
         # padded bucket. Off by default — the chaos harness's exact
@@ -277,6 +310,7 @@ class DynamicBatcher:
             req.future.trace_id = tr.start_request(
                 req.rid, rows=n, deadline_s=deadline_s,
                 t0=req.t_enqueue)
+        fast = False
         try:
             with self._cond:
                 if self._stop:
@@ -288,16 +322,35 @@ class DynamicBatcher:
                         f"queue at {self._rows} pending rows; watermark "
                         f"{self.queue_depth} would be exceeded by {n} "
                         "more")
-                self._q.append(req)
-                self._rows += n
-                self._cond.notify_all()
+                # The lane decision (ISSUE 14), atomic with admission:
+                # empty queue (scheduler.fastlane_eligible) AND a free
+                # window slot (try-acquire — the claimed slot is this
+                # request's in-flight slot, so the pipeline-depth bound
+                # holds across both lanes). Either half failing routes
+                # this submit down the ordinary coalescing path.
+                if (fastlane_eligible(self.fastlane, self._rows)
+                        and self._slots.acquire(blocking=False)):
+                    fast = True
+                    with self._inflight_lock:
+                        self._inflight += 1
+                else:
+                    self._q.append(req)
+                    self._rows += n
+                    self._cond.notify_all()
         except Exception:
             # never admitted: nothing will ever finish this trace
             if tr is not None:
                 tr.abort_request(req.rid)
             raise
         if self.controller is not None:
-            self.controller.on_arrival(n, now=req.t_enqueue)
+            self.controller.on_arrival(n, now=req.t_enqueue,
+                                       coalesced=not fast)
+        if fast:
+            # Dispatch + fetch + fan-out inline on THIS thread; the
+            # returned future is already resolved (or failed). Every
+            # path through _fast_dispatch releases the claimed slot
+            # and the in-flight count.
+            self._fast_dispatch(req)
         return req.future
 
     def pending_rows(self) -> int:
@@ -566,13 +619,16 @@ class DynamicBatcher:
             return live_fn()
         return getattr(self.engine, "version", None)
 
-    def _finish_trace(self, req: _Request, error=None) -> None:
+    def _finish_trace(self, req: _Request, error=None,
+                      t_end: Optional[float] = None) -> None:
         """Close the request's trace (no-op with no tracer). Always
         called BEFORE the future resolves: a client that has seen its
-        result/error can immediately read the finished trace."""
+        result/error can immediately read the finished trace. `t_end`
+        pins the root's end to a stamp the caller holds (the fast
+        lane's completion point)."""
         tr = trace.active()
         if tr is not None:
-            tr.finish_request(req.rid, error=error)
+            tr.finish_request(req.rid, error=error, t_end=t_end)
 
     def _fail_fanout(self, req: _Request, e: Exception) -> None:
         """Fail one request AND its dedup riders with the same error —
@@ -603,8 +659,149 @@ class DynamicBatcher:
         finally:
             trace.end_span(sp)
 
+    def _fast_dispatch(self, req: _Request) -> None:
+        """The bypass lane's whole pipeline, inline on the submitting
+        thread (ISSUE 14): dispatch (the engine's resident fast route
+        when one fits, the ordinary dispatch otherwise — either way no
+        thread hand-offs), the blocking fetch, and the fan-out. The
+        caller already holds one window slot and one in-flight count;
+        every path out of here releases both. Traces finish BEFORE the
+        future resolves, metrics record the same populations a
+        coalesced request gets, and failures feed the breaker — the
+        lane skips QUEUEING, never observability or resilience."""
+        t0 = time.monotonic()
+        sp = trace.begin_span("fastpath", rids=(req.rid,), rows=req.n)
+        try:
+            if sp is not None:
+                # admit span ends EXACTLY where the lane span begins:
+                # the submit-to-dispatch interval is covered gap-free,
+                # so attribution has no bookkeeping residue to hide
+                # (the lane's point is proving where microseconds go)
+                trace.add_span("fastpath.admit", req.t_enqueue, sp.t0,
+                               rids=(req.rid,))
+            if req.deadline is not None and t0 >= req.deadline:
+                # the pop-time shed, lane edition: submit's entry check
+                # ran microseconds ago, but deadline semantics must not
+                # depend on which lane a request took — an expired
+                # budget is shed at zero device cost here too
+                if self.metrics is not None:
+                    self.metrics.record_deadline_shed(req.n)
+                err = DeadlineExceeded(
+                    "deadline expired at fast-lane dispatch "
+                    f"({(t0 - req.deadline) * 1e3:.1f} ms past); "
+                    "shed before dispatch")
+                trace.add_span("deadline.shed", t0, t0,
+                               rids=(req.rid,))
+                trace.end_span(sp)
+                self._finish_trace(req, error=err)
+                req.future.set_exception(err)
+                with self._inflight_lock:
+                    self._inflight -= 1
+                self._slots.release()
+                return
+            try:
+                failpoint("batch.dispatch", rids=[req.rid])
+                fast = getattr(self.engine, "dispatch_fast", None)
+                handle = fast(req.x) if callable(fast) else None
+                if handle is None:
+                    # lane-contention / no-resident-route fallback:
+                    # still on the caller's thread, still queue-free —
+                    # only the staging shortcut is declined
+                    handle = self.engine.dispatch([req.x])
+            except Exception as e:   # singleton cohort: no bisection
+                # span closed BEFORE the trace finishes (a span ending
+                # after finish_request records to nothing)
+                trace.end_span(sp, error=type(e).__name__)
+                # _dispatch_failed owns the bookkeeping symmetry: it
+                # fails the future, feeds metrics + the breaker, drops
+                # the in-flight count and releases the caller's slot.
+                self._dispatch_failed([req], e)
+                return
+            with self._inflight_lock:
+                self._dispatched += 1
+                depth = self._dispatched
+            if self.metrics is not None:
+                self.metrics.record_dispatch(time.monotonic() - t0,
+                                             inflight=depth)
+            # fetch timing stamped HERE, not at lane entry: fetch_ms
+            # must measure the same interval on both lanes (the
+            # completion loop stamps immediately before its fetch too),
+            # or the side-by-side bench comparison reads skewed
+            t_fetch = time.monotonic()
+            fsp = trace.begin_span("engine.fetch", rids=(req.rid,),
+                                   bucket=handle.bucket)
+            try:
+                logits = self.engine.fetch(handle)
+            except Exception as e:
+                trace.end_span(fsp, error=type(e).__name__)
+                trace.end_span(sp)
+                self._fail_fanout(req, e)
+                if self.metrics is not None:
+                    self.metrics.record_fetch_error(1)
+                if self.resilience is not None:
+                    self.resilience.record_outcome(
+                        getattr(handle, "version", None), ok=False)
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    self._dispatched -= 1
+                self._slots.release()
+                return
+            finally:
+                trace.end_span(fsp)
+            t_done = time.monotonic()
+            version = getattr(handle, "version", None)
+            if self.resilience is not None:
+                self.resilience.record_outcome(version, ok=True)
+            if self.controller is not None:
+                self.controller.on_latency(t_done - req.t_enqueue)
+            req.future.version = version
+            # the lane span must close BEFORE the trace finishes (the
+            # finally's end is then an idempotent no-op): attribution
+            # reads only spans recorded into the still-live trace. The
+            # root's end is pinned to THIS stamp — the span's own end
+            # lands at-or-after it, so the lane request's wall clock is
+            # covered gap-free and attribution carries no bookkeeping
+            # residue (the leg's >= 0.95 bar is about exactly that).
+            t_end = time.monotonic()
+            trace.end_span(sp)
+            self._finish_trace(req, t_end=t_end)
+            req.future.set_result(logits[:req.n])
+            if self.metrics is not None:
+                self.metrics.record_fastpath(req.n)
+                self.metrics.record_fetch(t_done - t_fetch)
+                self.metrics.record_batch(
+                    rows=req.n, bucket=handle.bucket,
+                    queue_depth=self.pending_rows(), version=version,
+                    replica=getattr(handle, "replica", None),
+                    infer_dtype=getattr(handle, "infer_dtype", None))
+                self.metrics.record_latency(t_done - req.t_enqueue,
+                                            rows=req.n, version=version)
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._dispatched -= 1
+            self._slots.release()
+        finally:
+            trace.end_span(sp)
+
+    def _wait_for_work(self) -> bool:
+        """Park until the queue is non-empty (True) or the batcher is
+        stopping with nothing queued (False) — WITHOUT holding a
+        window slot. The old loop acquired its slot before this wait,
+        which meant an idle max_inflight=1 pipeline kept its only slot
+        hostage and the fast lane's try-acquire could never succeed;
+        the slot is now claimed only once there is work to coalesce,
+        which preserves the accumulate-while-full property (the
+        acquire still precedes the pop) without starving the lane."""
+        with self._cond:
+            while not self._q and not self._stop:
+                self._cond.wait(0.1)
+            return bool(self._q)
+
     def _dispatch_loop(self) -> None:
         while True:
+            if not self._wait_for_work():
+                self._handles.put(None)      # completion shutdown
+                return
             # Acquire the window slot BEFORE coalescing: while the
             # window is full, arriving requests keep accumulating toward
             # a fuller batch instead of being split across dispatches.

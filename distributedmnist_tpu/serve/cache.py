@@ -79,11 +79,13 @@ def content_key(version: Optional[str], infer_dtype: Optional[str],
 @dataclass
 class _Entry:
     """One cached response: the logits bytes plus the identity of the
-    engine set that computed them (checked again at read)."""
+    engine set that computed them (checked again at read) and the
+    monotonic insert stamp the TTL ages against (ISSUE 14 satellite)."""
 
     logits: np.ndarray
     version: Optional[str]
     infer_dtype: Optional[str]
+    t_insert: float = 0.0
 
 
 @dataclass
@@ -123,10 +125,19 @@ class PredictionCache:
     single-flight inserts that raced the swap are dropped, not cached.
     """
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096,
+                 ttl_s: Optional[float] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if ttl_s is not None and ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
         self.capacity = capacity
+        # Bounded staleness (ISSUE 14 satellite): entries expire by
+        # MONOTONIC age — a wall-clock step must never mass-expire (or
+        # immortalize) the cache (the DML004 discipline). An expired
+        # entry is dropped at lookup time and the lookup counts as a
+        # miss; None = no TTL (the PR 10 behavior).
+        self.ttl_s = ttl_s
         self._lock = make_lock("cache.state")
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._flights: dict[tuple, _Flight] = {}
@@ -139,6 +150,15 @@ class PredictionCache:
         self._evictions = 0
         self._invalidations = 0
         self._stale_drops = 0
+        self._expired = 0
+
+    def _expired_locked(self, entry: _Entry, now: float) -> bool:
+        """Caller holds the lock: True (and counted) when the entry
+        has aged past the TTL."""
+        if self.ttl_s is None or now - entry.t_insert <= self.ttl_s:
+            return False
+        self._expired += 1
+        return True
 
     # -- direct surface (unit tests, simple callers) -----------------------
 
@@ -150,6 +170,12 @@ class PredictionCache:
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
+                self._misses += 1
+                return None
+            if self._expired_locked(entry, time.monotonic()):
+                # aged past the TTL: dropped, counted, recomputed —
+                # an expired hit IS a miss (ISSUE 14 satellite)
+                del self._entries[key]
                 self._misses += 1
                 return None
             if entry.version != key[0] or entry.infer_dtype != key[1]:
@@ -182,7 +208,7 @@ class PredictionCache:
                 return False
             self._entries[key] = _Entry(
                 np.array(logits, copy=True), computed_version,
-                computed_dtype)
+                computed_dtype, t_insert=time.monotonic())
             self._entries.move_to_end(key)
             self._inserts += 1
             while len(self._entries) > self.capacity:
@@ -221,6 +247,8 @@ class PredictionCache:
             lookups = self._hits + self._misses
             return {
                 "capacity": self.capacity,
+                "ttl_s": self.ttl_s,
+                "expired": self._expired,
                 "entries": len(self._entries),
                 "inflight_keys": len(self._flights),
                 "hits": self._hits,
@@ -319,6 +347,13 @@ class CacheFront:
         leading = False
         with cache._lock:
             entry = cache._entries.get(key)
+            if (entry is not None
+                    and cache._expired_locked(entry, time.monotonic())):
+                # aged past the TTL (ISSUE 14 satellite): drop and
+                # fall through to the miss path — the next identical
+                # request recomputes under single-flight as usual
+                del cache._entries[key]
+                entry = None
             if entry is not None and entry.version == version \
                     and entry.infer_dtype == infer_dtype:
                 cache._entries.move_to_end(key)
@@ -503,7 +538,8 @@ def build_cache_front(cfg, batcher, router, registry, metrics=None):
     whatever comes back."""
     if not cfg.serve_cache:
         return batcher, None
-    cache = PredictionCache(cfg.serve_cache_capacity)
+    cache = PredictionCache(cfg.serve_cache_capacity,
+                            ttl_s=cfg.serve_cache_ttl_s)
     if hasattr(registry, "set_cache"):
         registry.set_cache(cache)
     return CacheFront(batcher, router, cache, metrics=metrics), cache

@@ -43,6 +43,24 @@ log = logging.getLogger("distributedmnist_tpu")
 IMAGE_SHAPE = (28, 28, 1)
 IMAGE_SIZE = 28 * 28
 
+# The fast lane's resident-staging ceiling (ISSUE 14): only rungs at or
+# below this keep a donated device buffer warm — large rungs are batch
+# territory, where the pooled staging path's costs amortize anyway.
+FASTLANE_MAX_BUCKET = 32
+
+
+def fast_row_bucket(buckets) -> Optional[int]:
+    """The one bucket rung the row-staged fast path can serve (ISSUE
+    14): a single-row request always covers into the SMALLEST rung, so
+    that is the only rung whose row-staging program is ever reachable —
+    and when that rung is 1, the exact-fit route already skips staging
+    entirely, so no row program exists at all. Shared with the static
+    compile-surface auditor (analysis/jaxcheck.py), whose reachable-key
+    universe must agree with what warmup compiles."""
+    ladder = sorted(set(buckets))
+    b = ladder[0]
+    return b if 1 < b <= FASTLANE_MAX_BUCKET else None
+
 
 @dataclasses.dataclass
 class InferenceHandle:
@@ -59,6 +77,10 @@ class InferenceHandle:
     #   (serve/registry.py labels; metrics split populations on it)
     infer_dtype: Optional[str] = None  # the computing engine's serving
     #   precision (ISSUE 7; metrics by_dtype attribution)
+    # Fast-lane handle (ISSUE 14): no pooled staging buffer to recycle
+    # (exact-fit or row-staged resident dispatch); one-shot enforcement
+    # then rides the logits reference instead of the staging one.
+    resident: bool = False
 
 
 def make_buckets(max_batch: int, n_chips: int,
@@ -153,6 +175,43 @@ class InferenceEngine:
         # cast, so XLA may reuse it (a no-op with a warning on backends
         # without donation, e.g. CPU — harmless).
         self._forward = jax.jit(forward, donate_argnums=1)
+
+        # The row-staged fast path (ISSUE 14): a single-row request
+        # covering the smallest rung stages ON DEVICE — the resident
+        # (bucket, 28, 28, 1) zero buffer is donated into a program
+        # that writes row 0 and runs the same forward body, returning
+        # the updated buffer to stay resident for the next dispatch.
+        # Rows past 0 are never written, so the zero padding survives
+        # every reuse; the host->device copy shrinks from bucket rows
+        # to ONE row and the host-side pad vanishes. One jitted
+        # function whose per-bucket specialization is jit's own shape
+        # cache, exactly like _forward — warmed (and audited by
+        # analysis/jaxcheck.py) as its own compile key.
+        def stage_row(params, buf, row):
+            staged = jax.lax.dynamic_update_slice(buf, row,
+                                                  (0, 0, 0, 0))
+            return forward(params, staged), staged
+
+        self._fast_row = jax.jit(stage_row, donate_argnums=1)
+        # Resident state for that path: the live device buffer plus the
+        # single-flight lock the lane's contention-fallback contract
+        # hangs off (a busy buffer means "fall back to the pooled
+        # path", never "wait"). Populated by warmup's fast-lane pass;
+        # None when the geometry has no row-staged rung (smallest rung
+        # 1, or past FASTLANE_MAX_BUCKET).
+        self._fast_row_b = fast_row_bucket(self.buckets)
+        # lint: allow[DML010] construction-time init before any thread can hold the lane lock
+        self._fast_row_buf = None
+        # Priced at warmup (the Clockwork discipline applied to the
+        # lane itself): the row-staged program only serves when its
+        # measured cost is no worse than the covering bucket's pooled
+        # dispatch — on a sharded multi-chip mesh the on-device row
+        # update can cost collectives the host-side pad never pays,
+        # and a "fast" path that measures slower must disable itself,
+        # not be believed. False until warmup proves it.
+        self._fast_row_ok = False
+        self._fast_row_cost = None
+        self._fast_row_lock = make_lock("engine.fastlane")
         # Host staging buffers, one free-list per bucket: dispatch() pads
         # requests into a pooled (bucket, 28, 28, 1) uint8 array instead
         # of allocating np.zeros + np.concatenate per call; fetch()
@@ -283,11 +342,83 @@ class InferenceEngine:
                                staging=staging, version=self.version,
                                infer_dtype=self.infer_dtype)
 
+    def dispatch_fast(self, x) -> Optional[InferenceHandle]:
+        """The fast lane's dispatch (ISSUE 14): stage WITHOUT the
+        pooled pad+device_put round-trip when a resident route fits,
+        or return None so the caller falls back to the ordinary
+        dispatch() path (the lane-contention fallback — never an
+        error, never a wait). Two resident routes:
+
+        - **exact fit** (n == covering bucket): the request array IS
+          the bucket shape, so it stages directly — no pool checkout,
+          no pad, no zero-fill;
+        - **row-staged** (n == 1 into a smallest rung > 1): the warm
+          donated device buffer takes the one row on device
+          (dynamic_update_slice fused into the forward's program), so
+          the host->device copy is one row instead of a padded bucket.
+          Single-flight per buffer: a concurrent holder means fall
+          back, because two donations of one buffer would race.
+
+        Thread-safe and callable from any submit thread; the batcher's
+        lane decision (queue empty + free window slot, under the queue
+        lock) is what bounds concurrency upstream."""
+        import jax
+
+        x = self._as_images(x)
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        row_staged = (n == 1 and b == self._fast_row_b
+                      and self._fast_row_ok
+                      and self._fast_row_buf is not None)
+        if n != b and not row_staged:
+            return None
+        # Same seam as dispatch(): a chaos schedule that poisons
+        # engine dispatches must cover the fast lane too.
+        failpoint("engine.dispatch", version=self.version, rows=n,
+                  bucket=b)
+        sp = trace.begin_span("engine.staging", rows=n, bucket=b,
+                              version=self.version, resident=True)
+        try:
+            if n == b:
+                # lint: allow[DML012] the engine IS the staging path: exact-fit fast-lane device_put
+                x_dev = jax.device_put(np.ascontiguousarray(x),
+                                       self._x_sharding)
+                logits = self._forward(self.params, x_dev)
+            else:
+                if not self._fast_row_lock.acquire(blocking=False):
+                    return None      # buffer busy: pooled path decides
+                try:
+                    # lint: allow[DML012] the engine IS the staging path: one-row fast-lane device_put
+                    row = jax.device_put(np.ascontiguousarray(x))
+                    # lint: allow[DML010] guarded by the try-acquired engine.fastlane lock above (non-blocking acquire, invisible to the lexical `with` inference)
+                    logits, self._fast_row_buf = self._fast_row(
+                        self.params, self._fast_row_buf, row)
+                finally:
+                    self._fast_row_lock.release()
+        finally:
+            trace.end_span(sp)
+        return InferenceHandle(logits=logits, n=n, bucket=b,
+                               staging=None, resident=True,
+                               version=self.version,
+                               infer_dtype=self.infer_dtype)
+
     def fetch(self, handle: InferenceHandle) -> np.ndarray:
         """Phase 2: the device->host VALUE fetch (blocks until the
         batch's compute is done — the result bytes a client would be
         sent, the StepTimer.barrier argument) plus the slice back to the
         real rows. Recycles the handle's staging buffer; one-shot."""
+        if handle.resident:
+            # Fast-lane handle (ISSUE 14): no pooled buffer to recycle;
+            # one-shot rides the logits reference instead.
+            if handle.logits is None:
+                raise RuntimeError("handle already fetched")
+            try:
+                failpoint("engine.fetch", version=handle.version,
+                          rows=handle.n)
+                blocking("engine.fetch device->host sync")
+                return np.asarray(handle.logits)[:handle.n]
+            finally:
+                handle.logits = None
         if handle.staging is None:
             raise RuntimeError("handle already fetched")
         # The staging buffer is recycled whether the fetch succeeds or
@@ -345,6 +476,15 @@ class InferenceEngine:
             samples.sort()
             costs_p95[b] = samples[min(len(samples) - 1,
                                        int(0.95 * len(samples)))]
+        # The fast lane's row-staging program (ISSUE 14) is its own
+        # compile key: warm it here so the first fast-lane dispatch
+        # after a promote pays a cache hit, not an XLA compile — the
+        # same Clockwork bar every bucket rung clears (the registry's
+        # verification re-run proves zero residual compiles for this
+        # key too, and analysis/jaxcheck.py audits it statically) —
+        # and PRICE it against the covering bucket's pooled dispatch,
+        # disabling the route where it measures slower.
+        self._warm_fastlane(costs)
         # One reference swap, not per-bucket mutation: a dispatch-thread
         # bucket_costs() read mid-warmup sees the old complete table or
         # the new complete table, never a half-written one.
@@ -357,6 +497,57 @@ class InferenceEngine:
                  {b: round(c * 1e3, 3)
                   for b, c in sorted(self._bucket_cost.items())})
         return n
+
+    def _warm_fastlane(self, costs: dict) -> None:
+        """Commit the resident device buffer, compile the row-staged
+        fast program (a no-op for geometries whose smallest rung is 1 —
+        the exact-fit route shares the ordinary per-bucket programs, so
+        there is nothing extra to warm), then PRICE it: the route is
+        enabled only when its measured single-row cost is no worse
+        than the covering bucket's pooled dispatch (`costs`, this
+        warmup's measurements). Runs at every warmup, so a
+        re-measurement pass re-proves the key warm and re-prices the
+        route for free.
+
+        Deliberately unconditional — warmed even for deployments whose
+        batcher never enables the lane: the row program is part of the
+        engine's warm surface exactly like a bucket rung, because an
+        operator flipping --serve-fastlane on (or a future admin lane
+        toggle) must never be the moment a cold key is discovered
+        (Clockwork's rule, again). The cost is one persistent-cache-
+        absorbed compile plus a ~25 KB uint8 buffer per engine."""
+        import jax
+
+        b = self._fast_row_b
+        if b is None:
+            return
+        with self._fast_row_lock:
+            if self._fast_row_buf is None:
+                # lint: allow[DML012] warmup-time resident-buffer commit, never per-request
+                self._fast_row_buf = jax.device_put(
+                    np.zeros((b, *IMAGE_SHAPE), np.uint8),
+                    self._x_sharding)
+            row = np.zeros((1, *IMAGE_SHAPE), np.uint8)
+            samples = []
+            for i in range(4):
+                t0 = time.perf_counter()
+                # lint: allow[DML012] warmup-time row placement priming the fast path's compile key
+                row_dev = jax.device_put(row)
+                logits, self._fast_row_buf = self._fast_row(
+                    self.params, self._fast_row_buf, row_dev)
+                np.asarray(logits)    # block: compile + timing honest
+                if i:                 # first call may pay the compile
+                    samples.append(time.perf_counter() - t0)
+            self._fast_row_cost = statistics.median(samples)
+            self._fast_row_ok = (self._fast_row_cost
+                                 <= costs.get(b, float("inf")))
+        if not self._fast_row_ok:
+            log.info(
+                "fast lane: row-staged b%d route DISABLED on this "
+                "host (%.3f ms vs pooled %.3f ms) — exact-fit and "
+                "queue-bypass still serve", b,
+                self._fast_row_cost * 1e3,
+                costs.get(b, float("nan")) * 1e3)
 
     def bucket_costs(self) -> dict[int, float]:
         """Measured seconds-per-dispatch of each bucket's compiled

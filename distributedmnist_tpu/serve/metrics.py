@@ -117,6 +117,15 @@ class ServeMetrics:
             self._cache_collapsed_requests = 0
             self._dedup_requests = 0
             self._dedup_rows = 0
+            # fast-lane accounting (ISSUE 14): requests that bypassed
+            # the coalescing path entirely — dispatched on the caller's
+            # thread through the lane decision. They feed every global
+            # population above too (the lane skips queueing, never
+            # observability); these counters are the LANE split, so an
+            # operator can read what fraction of traffic ran bypass vs
+            # coalesced at a glance.
+            self._fastpath_dispatches = 0
+            self._fastpath_rows = 0
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -167,6 +176,16 @@ class ServeMetrics:
                 s = self._by_dtype.setdefault(
                     infer_dtype, {"batches": 0, "rows": 0})
                 s["rows"] += rows
+
+    def record_fastpath(self, rows: int = 1) -> None:
+        """One request served through the single-request bypass lane
+        (ISSUE 14): dispatched on the caller's thread, no coalesce
+        wait, no queue hand-offs. Latency/batch/version populations
+        are recorded by the same hooks a coalesced request uses; this
+        is the lane-attribution counter."""
+        with self._lock:
+            self._fastpath_dispatches += 1
+            self._fastpath_rows += rows
 
     def record_dedup(self, requests: int, rows: int) -> None:
         """Intra-batch dedup riders (ISSUE 10): identical rows inside
@@ -407,6 +426,8 @@ class ServeMetrics:
                     self._cache_collapsed_requests,
                 "dedup_requests": self._dedup_requests,
                 "dedup_rows": self._dedup_rows,
+                "fastpath_dispatches": self._fastpath_dispatches,
+                "fastpath_rows": self._fastpath_rows,
                 "deadline_shed_requests": self._deadline_shed_requests,
                 "deadline_shed_rows": self._deadline_shed_rows,
                 "bisect_splits": self._bisect_splits,
@@ -508,6 +529,17 @@ class ServeMetrics:
             "dedup": {
                 "requests": c["dedup_requests"],
                 "rows": c["dedup_rows"],
+            },
+            # the lane split (ISSUE 14): bypass-lane requests vs the
+            # whole served population — lane_fraction near 1 at low
+            # load and near 0 under sustained traffic is the designed
+            # shape (the lane closes the moment contention appears)
+            "fastpath": {
+                "dispatches": c["fastpath_dispatches"],
+                "rows": c["fastpath_rows"],
+                "lane_fraction": (
+                    round(c["fastpath_dispatches"] / c["requests"], 4)
+                    if c["requests"] else None),
             },
             "fleet": {
                 "failovers": c["failovers"],
@@ -653,6 +685,17 @@ _PROM_HELP = {
         "dispatch.",
     "dmnist_serve_dedup_rows_total":
         "Device rows the intra-batch dedup did not dispatch.",
+    # single-request fast lane (ISSUE 14)
+    "dmnist_serve_fastpath_dispatches_total":
+        "Requests served through the single-request bypass lane "
+        "(dispatched on the caller's thread, no coalesce wait).",
+    "dmnist_serve_fastpath_rows_total":
+        "Rows served through the bypass lane.",
+    "dmnist_serve_fastpath_lane_fraction":
+        "Fraction of served requests that took the bypass lane.",
+    "dmnist_serve_cache_expired_total":
+        "Cache entries that aged past the TTL (expired hits count "
+        "as misses).",
 }
 
 
@@ -793,6 +836,14 @@ def prometheus_exposition(snapshot: dict,
          [({}, dd.get("requests"))])
     emit("dmnist_serve_dedup_rows_total", "counter",
          [({}, dd.get("rows"))])
+    # single-request fast lane (ISSUE 14): the lane split
+    fp = s.get("fastpath", {})
+    emit("dmnist_serve_fastpath_dispatches_total", "counter",
+         [({}, fp.get("dispatches"))])
+    emit("dmnist_serve_fastpath_rows_total", "counter",
+         [({}, fp.get("rows"))])
+    emit("dmnist_serve_fastpath_lane_fraction", "gauge",
+         [({}, fp.get("lane_fraction"))])
     if cache:
         emit("dmnist_serve_cache_hits_total", "counter",
              [({}, cache.get("hits"))])
@@ -810,6 +861,8 @@ def prometheus_exposition(snapshot: dict,
              [({}, cache.get("invalidations"))])
         emit("dmnist_serve_cache_stale_drops_total", "counter",
              [({}, cache.get("stale_drops"))])
+        emit("dmnist_serve_cache_expired_total", "counter",
+             [({}, cache.get("expired"))])
         emit("dmnist_serve_cache_entries", "gauge",
              [({}, cache.get("entries"))])
         emit("dmnist_serve_cache_inflight_keys", "gauge",

@@ -54,7 +54,33 @@ from typing import Any, Callable
 
 import numpy as np
 
-INFER_DTYPES = ("float32", "bfloat16", "int8")
+INFER_DTYPES = ("float32", "bfloat16", "int8", "megakernel")
+
+# The whole-net fused-inference variant (ISSUE 14): full f32 numerics
+# served through ONE ops/fused.py megakernel call per dispatch instead
+# of the per-layer chain — a KERNEL variant, not a precision, but it
+# rides the same variant machinery (registry warm + zero-compile
+# prove-it + parity gate, router labels, cache keys, by_dtype metrics)
+# because that machinery is exactly what "an alternative compiled
+# forward that must prove itself before taking traffic" needs. Only
+# models listed here have a megakernel; the registry and the static
+# auditor (analysis/jaxcheck.py) both consult variant_supported so an
+# unsupported model's auto-activation skips it instead of failing it.
+MEGAKERNEL = "megakernel"
+MEGAKERNEL_MODELS = ("mlp",)
+
+
+def variant_supported(model, infer_dtype: str) -> bool:
+    """Whether `model` (a models.* instance or its config name) can
+    build the `infer_dtype` variant at all — the megakernel exists for
+    the MLP only; every other dtype is model-agnostic."""
+    if infer_dtype != MEGAKERNEL:
+        return infer_dtype in INFER_DTYPES
+    if isinstance(model, str):
+        return model in MEGAKERNEL_MODELS
+    from distributedmnist_tpu import models
+
+    return isinstance(model, models.MLP)
 
 
 def quantize_channelwise(w) -> tuple[np.ndarray, np.ndarray]:
@@ -169,6 +195,31 @@ def _prepare_mlp(params, infer_dtype: str, mode: str):
     return prep, forward
 
 
+def _prepare_mlp_megakernel(params, mode: str):
+    """The whole-net fused-inference forward (ISSUE 14): float32
+    numerics, /255 folded into the first layer's weights at load (the
+    quantized variants' trick, applied at full precision), and the
+    entire dense stack dispatched as ONE ops/fused.py megakernel call
+    — the per-dispatch overhead of the layer chain collapses to a
+    single kernel launch, which is where single-request latency lives.
+    On the XLA route (CPU serving) the 'kernel' is the jnp oracle XLA
+    fuses; PALLAS/PALLAS_INTERPRET run the real single pallas_call."""
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.ops import fused
+
+    w1, b1, w2, b2 = _mlp_weights(params)
+    prep = {"w1": (w1 / 255.0).astype(np.float32), "b1": b1,
+            "w2": w2, "b2": b2}
+
+    def forward(p, x_u8):
+        x = x_u8.reshape(x_u8.shape[0], -1).astype(jnp.float32)
+        return fused.mlp_megakernel(x, p["w1"], p["b1"], p["w2"],
+                                    p["b2"], mode)
+
+    return prep, forward
+
+
 def _prepare_lenet(params, infer_dtype: str, mode: str):
     import jax.numpy as jnp
 
@@ -270,6 +321,13 @@ def prepare_inference(model, params, infer_dtype: str,
     import jax
 
     params = jax.tree.map(np.asarray, params)
+    if infer_dtype == MEGAKERNEL:
+        if not isinstance(model, models.MLP):
+            raise ValueError(
+                f"no megakernel for model {type(model).__name__}: the "
+                "whole-net fused forward exists for the MLP only "
+                "(MEGAKERNEL_MODELS) — other dtypes still apply")
+        return _prepare_mlp_megakernel(params, fused_mode)
     if isinstance(model, models.MLP):
         return _prepare_mlp(params, infer_dtype, fused_mode)
     if isinstance(model, models.LeNet5):

@@ -337,6 +337,37 @@ class Router:
                     trace.end_span(sp)
         return rh
 
+    def dispatch_fast(self, x) -> Optional[RoutedHandle]:
+        """The fast lane's routed dispatch (ISSUE 14): resolve the live
+        target once and try its engine's resident staging route,
+        returning None whenever the full dispatch() semantics are
+        needed instead — a configured candidate (canary fractions and
+        shadow duplication are defined over COALESCED dispatches; the
+        bypass must not silently thin either population), an engine
+        without a fast route, or a busy resident buffer. The caller
+        (DynamicBatcher's lane) falls back to dispatch() on the same
+        thread, so declining the lane costs a hand-off, never an
+        error. NoLiveModel still raises — warming is a 503, not a
+        fallback."""
+        with self._lock:
+            live, canary, shadow = self._live, self._canary, self._shadow
+        if live is None:
+            raise NoLiveModel(
+                "no warmed model version is live (server warming?)")
+        if canary is not None or shadow is not None:
+            return None
+        fast = getattr(live.engine, "dispatch_fast", None)
+        if not callable(fast):
+            return None
+        h = fast(x)
+        if h is None:
+            return None
+        return RoutedHandle(handle=h, engine=live.engine,
+                            version=live.version, n=h.n, bucket=h.bucket,
+                            replica=self.replica,
+                            infer_dtype=getattr(live.engine,
+                                                "infer_dtype", None))
+
     def fetch(self, rh: RoutedHandle) -> np.ndarray:
         try:
             out = rh.engine.fetch(rh.handle)

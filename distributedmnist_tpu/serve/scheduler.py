@@ -173,6 +173,20 @@ def plan_segments(sizes: Sequence[int], buckets: Sequence[int],
     return [b - a for a, b in zip(cuts, cuts[1:])]
 
 
+def fastlane_eligible(enabled: bool, pending_rows: int) -> bool:
+    """The bypass lane's admission rule (ISSUE 14), pure policy like
+    everything in this module: a submit may skip the coalescing path
+    only when the lane is on AND the queue is EMPTY. A non-empty queue
+    means there is traffic worth coalescing with — jumping it would
+    both reorder FIFO service and starve the drain of exactly the rows
+    that make batching pay. The second half of the decision (a free
+    in-flight window slot) is a semaphore try-acquire with a side
+    effect, so it stays in the batcher, made under the same queue lock
+    as this predicate — the drain/stop/shed invariants (and the PR 11
+    explored machines) see one atomic lane decision."""
+    return enabled and pending_rows == 0
+
+
 class AdaptiveController:
     """AIMD effective-wait controller + arrival-rate EWMA (thread-safe).
 
@@ -210,16 +224,28 @@ class AdaptiveController:
         self._win_max = 0.0
         self._violations = 0
         self._increases = 0
+        self._fastpath = 0                # bypass-lane dispatches seen
 
     # -- inputs ------------------------------------------------------------
 
-    def on_arrival(self, rows: int = 1, now: Optional[float] = None
-                   ) -> None:
+    def on_arrival(self, rows: int = 1, now: Optional[float] = None,
+                   coalesced: bool = True) -> None:
         """One accepted request of `rows` rows; feeds the arrival-rate
-        EWMA (irregular-interval exponential decay, tau=rate_tau_s)."""
+        EWMA (irregular-interval exponential decay, tau=rate_tau_s).
+
+        `coalesced=False` marks a fast-lane bypass (ISSUE 14): counted,
+        but EXCLUDED from the rate EWMA — the fill-time cap prices how
+        fast the QUEUE fills toward max_batch, and a request that never
+        entered the queue must not make the controller believe drains
+        fill faster than they do (which would shorten the wait exactly
+        when the lane is already serving the lone-request traffic the
+        wait exists to protect)."""
         if now is None:
             now = time.monotonic()
         with self._lock:
+            if not coalesced:
+                self._fastpath += 1
+                return
             if self._t_last is None:
                 self._t_last = now
                 return
@@ -279,4 +305,5 @@ class AdaptiveController:
                 "arrival_rate_rows_per_sec": round(self._rate, 1),
                 "violations": self._violations,
                 "increases": self._increases,
+                "fastpath_dispatches": self._fastpath,
             }

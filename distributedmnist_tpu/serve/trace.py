@@ -80,6 +80,13 @@ Span name table (stage -> what it times -> mechanism):
                             leader's computation
     batch.dedup             intra-batch dedup riders collapsed onto a
                             representative dispatch (zero-width marker)
+    fastpath                the single-request bypass lane's inline
+                            dispatch+fetch on the caller's thread
+                            (ISSUE 14; staging/fetch children nest
+                            inside it and claim their own time)
+    fastpath.admit          submit to lane dispatch begin (validation
+                            + the atomic lane decision under the
+                            queue lock)
 """
 
 from __future__ import annotations
@@ -126,6 +133,13 @@ STAGE_OF = {
     "cache.lookup": ("cache", 90),
     "cache.hit": ("cache", 90),
     "cache.collapse": ("cache", 85),
+    # single-request bypass lane (ISSUE 14): `fastpath` wraps the whole
+    # inline dispatch+fetch at LOW priority so the nested staging/fetch
+    # stages claim their own microseconds and the lane keeps only the
+    # bookkeeping remainder; `fastpath.admit` closes the submit-to-
+    # dispatch gap so attribution of a lane request has no residue
+    "fastpath": ("fastpath", 8),
+    "fastpath.admit": ("fastpath", 18),
 }
 
 
@@ -338,14 +352,19 @@ class Tracer:
             if self._live.pop(rid, None) is not None:
                 self._aborted += 1
 
-    def finish_request(self, rid: int, error=None) -> None:
+    def finish_request(self, rid: int, error=None,
+                       t_end: Optional[float] = None) -> None:
         """Close the trace: synthesize the root `request` span, decide
         retention (exemplar for errored/over-SLO, else the sampling
         draw), and make the stage breakdown available for Server-Timing
         lookups. Callers finish BEFORE resolving the request's future,
         so a client that has seen its result can immediately read the
-        finished trace."""
-        now = time.monotonic()
+        finished trace. `t_end` pins the root's end to a stamp the
+        caller already holds (the fast lane's completion point —
+        ISSUE 14): a root that ends a descheduling-blip later than its
+        last child would charge pure bookkeeping to the residue, and
+        the lane's attribution bar is exactly about leaving none."""
+        now = t_end if t_end is not None else time.monotonic()
         with self._lock:
             acc = self._live.pop(rid, None)
             if acc is None:

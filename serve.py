@@ -123,6 +123,17 @@ additionally collapses identical rows inside one coalesced drain
 counters and the hit ratio (JSON `cache` block + dmnist_serve_cache_*
 Prometheus series).
 
+Fast lane (ISSUE 14, serve/batcher.py + engine.dispatch_fast):
+--serve-fastlane opens the single-request low-latency bypass — a
+submit that finds the queue empty and a free in-flight slot dispatches
+immediately on the caller's thread (no coalesce timer, no queue
+hand-offs; device-resident staging for small buckets, priced at
+warmup), falling back to the coalescing path the moment contention
+appears. /metrics reports the lane split (`fastpath`);
+--serve-cache-ttl-s adds bounded staleness to the prediction cache
+(entries expire by monotonic age; expired hits count as misses,
+`dmnist_serve_cache_expired_total`).
+
 Tracing (ISSUE 9, serve/trace.py): --serve-trace installs the
 per-request span tracer. Each request's path (queue wait, staging,
 device window, fetch, rescues, bisect retries) is recorded as a span
@@ -893,6 +904,9 @@ def main(argv=None) -> int:
     if (args.serve_cache_capacity is not None
             and args.serve_cache_capacity < 1):
         p.error("--serve-cache-capacity must be >= 1")
+    if (args.serve_cache_ttl_s is not None
+            and args.serve_cache_ttl_s <= 0):
+        p.error("--serve-cache-ttl-s must be > 0")
     if args.serve_faults is not None:
         # a malformed chaos schedule is a usage error NOW — it must
         # never boot a server that silently injects nothing
@@ -938,7 +952,13 @@ def main(argv=None) -> int:
                              adaptive=cfg.serve_adaptive,
                              resilience=resilience,
                              dedup=cfg.serve_dedup,
+                             fastlane=cfg.serve_fastlane,
                              metrics=metrics).start()
+    if cfg.serve_fastlane:
+        log.info("single-request fast lane ACTIVE: an idle pipeline "
+                 "dispatches lone requests on the caller's thread "
+                 "(no coalesce wait); contention falls back to "
+                 "coalescing")
     # The prediction cache + single-flight front layer (ISSUE 10):
     # front is the submit target (== batcher when --serve-cache is
     # off); the registry invalidates the cache atomically on every
@@ -1000,12 +1020,14 @@ def main(argv=None) -> int:
                                   front=front, cache=cache)
     finally:
         batcher.stop()
-    # Sanitizer verdict AFTER stop() (DMNIST_SANITIZE=1 runs): the
-    # dispatch thread holds a legitimate pre-coalescing lookahead slot
-    # while the batcher is merely idle — "slots net zero" is only a
+    # Sanitizer verdict AFTER stop() (DMNIST_SANITIZE=1 runs): a
+    # mid-drain dispatch cycle legitimately holds a window slot while
+    # its batch is popped-but-unresolved — "slots net zero" is only a
     # valid invariant once the pipeline is actually stopped, so a
     # snapshot taken mid-serve would flakily report that hold as a
-    # leak.
+    # leak. (The idle pipeline itself holds no slot since ISSUE 14:
+    # the dispatch thread claims one only once there is work, which is
+    # what lets the fast lane's try-acquire succeed at depth 1.)
     summary.update(_sanitizer_block())
     print(json.dumps(summary), flush=True)
     return 0

@@ -44,9 +44,36 @@ def test_small_audit_is_closed():
     assert r["findings"] == []
     assert r["static_keys"] == r["warmed_keys"] > 0
     dtypes = set(r["infer_dtypes"])
-    assert dtypes == {"float32", "bfloat16", "int8"}   # the auto universe
+    # the auto universe: every PARITY_GATES dtype this model supports
+    # (the megakernel variant exists for the MLP — ISSUE 14)
+    assert dtypes == {"float32", "bfloat16", "int8", "megakernel"}
     assert len(r["fingerprints"]) == len(dtypes) * len(r["buckets"])
     assert all(len(fp) == 16 for fp in r["fingerprints"].values())
+
+
+def test_megakernel_filtered_by_model_support():
+    """The megakernel variant exists for the MLP only: the LeNet
+    universe must not contain it (an engine that can never be built
+    has no compile keys), and the MLP universe audits it CLOSED."""
+    r = jc.audit_target(jc.AuditTarget(model="lenet", serve_max_batch=8))
+    assert "megakernel" not in r["infer_dtypes"]
+    assert r["findings"] == []
+
+
+def test_fast_row_key_in_universe_when_smallest_rung_gt_one():
+    """A geometry whose smallest rung is > 1 serves single-row
+    requests through the row-staged fast program (ISSUE 14): its key
+    joins the reachable universe, the real warmup warms it (closure),
+    and it carries its own fingerprint."""
+    r = jc.audit_target(small_target(n_chips=4))
+    assert r["findings"] == []
+    assert r["static_keys"] == r["warmed_keys"]
+    row_keys = [k for k in r["fingerprints"] if k.endswith("-row")]
+    assert row_keys and all("/b4-row" in k for k in row_keys)
+    # 1-chip geometry (smallest rung 1): exact-fit covers single rows,
+    # so there is no row program and no row key
+    r1 = jc.audit_target(small_target())
+    assert not any(k.endswith("-row") for k in r1["fingerprints"])
 
 
 def test_explicit_dtype_narrows_the_universe():
@@ -272,15 +299,17 @@ def test_update_snapshots_partial_refuses_cross_version(tmp_path,
 
 
 def test_compile_surface_summary_stable_and_geometry_sensitive():
+    # smallest rung 4 > 1: the fast lane's row-staged program is one
+    # more key per dtype (ISSUE 14)
     a = jc.compile_surface_summary("mlp", (4, 8), 8, "float32")
     b = jc.compile_surface_summary("mlp", (4, 8), 8, "float32")
-    assert a["static_keys"] == 2 and a["findings"] == 0
+    assert a["static_keys"] == 3 and a["findings"] == 0
     assert a["fingerprint_set_hash"] == b["fingerprint_set_hash"]
     c = jc.compile_surface_summary("mlp", (4, 8, 16), 16, "float32")
-    assert c["static_keys"] == 3
+    assert c["static_keys"] == 4
     assert c["fingerprint_set_hash"] != a["fingerprint_set_hash"]
     d = jc.compile_surface_summary("mlp", (4, 8), 8, "int8")
-    assert d["static_keys"] == 4          # f32 base + the int8 variant
+    assert d["static_keys"] == 6      # (f32 base + int8) x (2 rungs + row)
     assert d["fingerprint_set_hash"] != a["fingerprint_set_hash"]
 
 

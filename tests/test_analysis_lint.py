@@ -4,6 +4,7 @@ contract (reason REQUIRED), scope boundaries, and the repo-at-HEAD
 gate (`python -m distributedmnist_tpu.analysis` exits 0 — the
 acceptance criterion scripts/tier1.sh enforces before pytest)."""
 
+import os
 import subprocess
 import sys
 
@@ -14,6 +15,7 @@ from distributedmnist_tpu.analysis import lint
 pytestmark = pytest.mark.analysis
 
 SERVE_REL = "distributedmnist_tpu/serve/somemodule.py"
+PLUMBING_REL = "distributedmnist_tpu/serve/batcher.py"
 
 
 def _rules(text, rel=SERVE_REL):
@@ -176,8 +178,10 @@ def test_dml007_unprotected_begin_span_flagged():
            "def dispatch(self, seg):\n"
            "    sp = trace.begin_span('batch.dispatch', rids=[1])\n"
            "    return self.engine.dispatch(seg)\n")
-    assert _rules(src) == ["DML007"]
-    f = lint.lint_source(src, SERVE_REL)[0]
+    # linted at the batcher's path: engine.dispatch is plumbing there
+    # (a non-plumbing module would additionally earn DML015)
+    assert _rules(src, PLUMBING_REL) == ["DML007"]
+    f = lint.lint_source(src, PLUMBING_REL)[0]
     assert f.line == 3 and "end_span" in f.message
 
 
@@ -189,7 +193,7 @@ def test_dml007_try_finally_end_is_clean():
            "        return self.engine.dispatch(seg)\n"
            "    finally:\n"
            "        trace.end_span(sp)\n")
-    assert _rules(src) == []
+    assert _rules(src, PLUMBING_REL) == []
     # try/except/finally (the completion-loop shape) is protected too
     src2 = ("from distributedmnist_tpu.serve import trace\n"
             "def fetch(self, h):\n"
@@ -201,7 +205,7 @@ def test_dml007_try_finally_end_is_clean():
             "        raise\n"
             "    finally:\n"
             "        trace.end_span(sp)\n")
-    assert _rules(src2) == []
+    assert _rules(src2, PLUMBING_REL) == []
 
 
 def test_dml007_end_outside_finally_not_enough():
@@ -216,7 +220,7 @@ def test_dml007_end_outside_finally_not_enough():
            "        return out\n"
            "    except Exception:\n"
            "        raise\n")
-    assert _rules(src) == ["DML007"]
+    assert _rules(src, PLUMBING_REL) == ["DML007"]
 
 
 def test_dml007_nested_statement_lists_checked():
@@ -695,6 +699,49 @@ def test_dml014_lint_selftest_fixtures_are_not_coverage():
                                              "c = 'batch.dispatch'\n")}
     findings = lint.check_failpoint_coverage(texts)
     assert sorted(f.rule for f in findings) == ["DML014"] * 3
+
+
+# -- DML015: dispatch outside the lane-deciding plumbing (ISSUE 14) --------
+
+
+def test_dml015_direct_dispatch_outside_plumbing_flagged():
+    """A serve/ module calling the engine surface directly bypasses
+    the batcher's lane decision — metrics/trace/faults would silently
+    skip that request path."""
+    for call in ("self.engine.dispatch([x])",
+                 "engine.dispatch_fast(x)",
+                 "self.router.infer(x)"):
+        src = f"def f(self, engine, x):\n    return {call}\n"
+        assert _rules(src) == ["DML015"], call
+    f = lint.lint_source("def f(e, x):\n    return e.dispatch(x)\n",
+                         SERVE_REL)[0]
+    assert "lane decision" in f.message
+
+
+def test_dml015_plumbing_modules_and_non_serve_exempt():
+    src = "def f(e, x):\n    return e.dispatch(x)\n"
+    for rel in ("distributedmnist_tpu/serve/batcher.py",
+                "distributedmnist_tpu/serve/router.py",
+                "distributedmnist_tpu/serve/fleet.py",
+                "distributedmnist_tpu/serve/engine.py",
+                "tests/test_serve_engine.py", "bench.py", "serve.py"):
+        assert _rules(src, rel) == [], rel
+
+
+def test_dml015_registry_parity_gate_is_allowlisted():
+    """The registry's parity-gate infer() calls are the sanctioned
+    admin-path exception — present, and reason-allowlisted rather
+    than invisible to the rule."""
+    rel = "distributedmnist_tpu/serve/registry.py"
+    path = os.path.join(lint.repo_root(), rel)
+    text = open(path, encoding="utf-8").read()
+    findings = lint.lint_source(text, rel)
+    d15 = [f for f in findings if f.rule == "DML015"]
+    assert d15, "expected the parity gate's infer() sites to be seen"
+    active, allowed = lint.apply_allowlist(findings, text.splitlines())
+    assert not [f for f in active if f.rule == "DML015"]
+    assert all("parity" in f.allow_reason for f in allowed
+               if f.rule == "DML015")
 
 
 # -- allowlist pragma ------------------------------------------------------
