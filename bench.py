@@ -237,6 +237,18 @@ def main(argv=None) -> int:
                         "this process — one record with per-dtype "
                         "img/s/chip, parity metrics, bucket cost "
                         "tables and recompile counts (must stay 0)")
+    p.add_argument("--cascade", action="store_true", default=None,
+                   help="[serve] add the confidence-gated cascade leg "
+                        "(ISSUE 17): warm + parity-gate int8, calibrate "
+                        "the escalation threshold on the held-out batch "
+                        "(composed-accuracy gate), then run "
+                        "exact/fast/balanced (+ a stressed-threshold "
+                        "point) closed-loop back-to-back on one seeded "
+                        "stream — the goodput-vs-accuracy frontier with "
+                        "measured end-to-end agreement, escalation "
+                        "fractions, and recompile counts (must stay 0; "
+                        "bars: cascade goodput >= 1.5x f32 at "
+                        "agreement >= 0.995)")
     p.add_argument("--baseline", default=None, metavar="BENCH_serve.json",
                    help="[serve] a prior BENCH_serve_r*.json to diff "
                         "against: prints a delta table and REFUSES "
@@ -305,6 +317,7 @@ def main(argv=None) -> int:
                    "--serve-cache": args.serve_cache,
                    "--serve-cache-capacity": args.serve_cache_capacity,
                    "--dtype-sweep": args.dtype_sweep,
+                   "--cascade": args.cascade,
                    "--baseline": args.baseline,
                    "--chaos": args.chaos,
                    "--trace": args.trace,
@@ -1271,6 +1284,243 @@ def _serve_dtype_sweep(registry, router, factory, metrics, make_batcher,
         "variant_warmup_compile_events": warmup_events,
     }
     _mark(f"dtype sweep: speedups vs f32 {speedups} (best {best})")
+    return leg
+
+
+def _serve_cascade_leg(registry, router, factory, metrics, make_batcher,
+                       compiles, pipelined: int, clients: int,
+                       duration: float) -> dict:
+    """The confidence-gated cascade leg (ISSUE 17 acceptance): warm +
+    parity-gate the int8 variant, calibrate the cascade's confidence
+    threshold on the held-out batch (the composed-accuracy gate), then
+    drive ONE seeded mixed-size request stream closed-loop through the
+    three accuracy classes back-to-back — `exact` (the f32-only
+    baseline), `fast` (the int8-only ceiling), `balanced` (the cascade)
+    — plus a stressed operating point with the threshold overridden to
+    the stream's median cheap-stage margin, so the record shows the
+    goodput-vs-accuracy FRONTIER, not one point.
+
+    Each phase runs on its own batcher with the same cost-derived
+    coalescing wait and asserts its own recompile count stays 0: the
+    cascade's escalation re-submissions ride the normal coalescing path
+    through programs the warmup already compiled, so a nonzero count
+    here means the cascade leaked a new jit key. End-to-end argmax
+    agreement vs the f32 baseline is MEASURED on the stream (not
+    inferred from the gate), and the escalation fraction comes from the
+    serving metrics of each phase's own window. A gate refusal is the
+    leg's result (skipped-with-reason), never a silently-measured
+    cascade."""
+    import numpy as np
+
+    from distributedmnist_tpu.serve.cascade import (CascadeFront,
+                                                    softmax_margin)
+    from distributedmnist_tpu.serve.scheduler import fit_dispatch_cost
+
+    version = registry.live_version()
+    restore_dtype = router.live_infer_dtype() or "float32"
+    max_size = min(32, factory.max_batch)
+    rng = np.random.default_rng(13)
+    sizes = [int(s) for s in rng.integers(1, max_size + 1, 256)]
+    reqs = [rng.integers(0, 256, (n, 28, 28, 1), dtype=np.uint8)
+            for n in sizes]
+    # Warmup-compile accounting by counter delta (same treatment as the
+    # dtype sweep): the int8 variant build + the calibration pass are
+    # legitimate off-hot-path warmup, excluded from the caller's
+    # whole-run recompile check via variant_warmup_compile_events.
+    before_compiles = compiles.snapshot()
+    try:
+        registry.add_variant(version, "int8")
+        state = registry.enable_cascade(version)
+    except Exception as e:
+        warmup = compiles.snapshot() - before_compiles
+        _mark(f"cascade leg: REFUSED ({e})")
+        return {"skipped": f"{type(e).__name__}: {e}",
+                "variant_warmup_compile_events": warmup}
+    warmup_events = compiles.snapshot() - before_compiles
+    calibrated = dict(state.calibration)
+    _mark(f"cascade leg: calibrated threshold "
+          f"{state.threshold:.6f} (cheap {state.cheap_dtype}, gate "
+          f"composed_agreement {calibrated.get('composed_agreement')}, "
+          f"escalation {calibrated.get('escalation_fraction')})")
+    # The host's physical ceiling for this frontier: the warmup-
+    # measured full-bucket cost ratio between the f32 reference and
+    # the cheap stage. The 1.5x goodput bar presumes a host where the
+    # cheap variant's compute win is at least that large (TPU int8,
+    # or the r06-class CPU where int8 measured 2.35x); on a host
+    # whose ceiling sits BELOW the bar (e.g. weight-only int8 on a
+    # 1-core XLA-CPU box — PARITY.md's route disclosure) no cascade
+    # can clear it, and the record says so explicitly instead of
+    # letting an unreachable bar read as a cascade regression.
+    top = factory.buckets[-1]
+    registry.promote(version, infer_dtype=state.cheap_dtype)
+    cheap_costs = dict(router.bucket_costs())
+    registry.promote(version, infer_dtype="float32")
+    f32_costs = dict(router.bucket_costs())
+    compute_ceiling = (round(f32_costs[top] / cheap_costs[top], 3)
+                       if cheap_costs.get(top) and f32_costs.get(top)
+                       else None)
+    # f32 cost table exists (bootstrap warmup); derive the shared wait
+    overhead_s, per_row_s = fit_dispatch_cost(f32_costs)
+    wait_us = max(2000, int(
+        (overhead_s + per_row_s * factory.buckets[-1]) * 1e6))
+    n_chips = factory.total_chips
+    _mark(f"cascade leg: host compute ceiling {compute_ceiling}x "
+          f"(f32 {round(f32_costs[top] * 1e3, 2)} ms vs "
+          f"{state.cheap_dtype} {round(cheap_costs[top] * 1e3, 2)} ms "
+          f"per {top}-row bucket)")
+
+    # -- measured end-to-end agreement + the stressed threshold -------
+    # One warmed batcher, pairwise-concurrent submits: every probe
+    # request runs through all three classes, giving (a) the MEASURED
+    # argmax agreement of the cascade and the int8 ceiling against the
+    # f32 baseline on this stream — the frontier's accuracy axis — and
+    # (b) the cheap-stage margins whose median becomes the stressed
+    # phase's override threshold (~half the rows escalate there).
+    probe = reqs[:64]
+    agree = {"fast": 0, "balanced": 0}
+    total_rows = 0
+    margins: list = []
+    b = make_batcher(pipelined, adaptive=False, wait_us=wait_us)
+    front = CascadeFront(b, b, router, registry, metrics=metrics)
+    try:
+        for x in probe:
+            futs = {cls: front.submit(x, accuracy_class=cls)
+                    for cls in ("exact", "fast", "balanced")}
+            out = {cls: f.result(timeout=120) for cls, f in futs.items()}
+            ref = out["exact"].argmax(axis=1)
+            for cls in ("fast", "balanced"):
+                agree[cls] += int((out[cls].argmax(axis=1) == ref).sum())
+            margins.extend(
+                np.asarray(softmax_margin(out["fast"])).tolist())
+            total_rows += x.shape[0]
+        _drain_or_die(b, timeout=120)
+    finally:
+        b.stop()
+    agreement = {cls: round(n / total_rows, 5) for cls, n in agree.items()}
+    stressed_threshold = float(min(0.999999, max(
+        1e-9, float(np.median(np.asarray(margins))))))
+    _mark(f"cascade agreement vs f32 on {total_rows} rows: "
+          f"balanced {agreement['balanced']}, fast {agreement['fast']}; "
+          f"median cheap-stage margin {stressed_threshold:.6f}")
+
+    # -- the frontier: four closed-loop phases on one stream ----------
+    phases = [("exact", None), ("fast", None), ("balanced", None),
+              ("balanced_stressed", stressed_threshold)]
+    legs = {}
+    for name, override in phases:
+        cls = "balanced" if name == "balanced_stressed" else name
+        if override is not None:
+            try:
+                # judged by the SAME composed gate as calibration —
+                # a refused override is reported, never measured
+                registry.set_cascade_threshold(version, override)
+            except RuntimeError as e:
+                legs[name] = {"skipped": f"{type(e).__name__}: {e}"}
+                _mark(f"cascade [{name}]: override REFUSED ({e})")
+                continue
+        steady = compiles.snapshot()
+        b = make_batcher(pipelined, adaptive=False, wait_us=wait_us)
+        front = CascadeFront(b, b, router, registry, metrics=metrics,
+                             default_class=cls)
+        try:
+            _mark(f"cascade closed loop [{name}]: {clients} clients x "
+                  f"{duration:.0f}s, sizes U[1,{max_size}], wait "
+                  f"{wait_us}us")
+            closed = _serve_closed_loop(front, metrics, reqs, clients,
+                                        duration)
+        finally:
+            b.stop()
+        ca = closed.get("cascade", {})
+        legs[name] = {
+            "accuracy_class": cls,
+            "threshold": (override if override is not None
+                          else state.threshold),
+            "img_s_chip": round(closed["rows_per_sec"] / n_chips, 1),
+            "requests_per_sec": closed["requests_per_sec"],
+            "latency_ms": closed["latency_ms"],
+            "mean_rows_per_batch": closed["mean_rows_per_batch"],
+            "by_dtype": closed["by_dtype"],
+            "stage_rows": ca.get("stage_rows"),
+            "escalation_fraction": ca.get("escalation_fraction"),
+            "degraded_requests": ca.get("degraded_requests"),
+            # steady state over pre-warmed, gate-verified programs:
+            # escalation re-submission must never mint a new jit key
+            "recompiles_after_warmup": compiles.snapshot() - steady,
+        }
+        _mark(f"cascade [{name}]: {legs[name]['img_s_chip']} img/s/chip "
+              f"(p99 {closed['latency_ms']['p99']} ms, escalation "
+              f"{legs[name]['escalation_fraction']}, "
+              f"{legs[name]['recompiles_after_warmup']} recompiles)")
+    # restore the calibrated threshold (the stressed override is a
+    # bench operating point, not the state a later leg should inherit)
+    final_state = registry.enable_cascade(version)
+    registry.promote(version, infer_dtype=restore_dtype)
+
+    f32 = legs.get("exact", {}).get("img_s_chip")
+    goodput = {name: (round(leg["img_s_chip"] / f32, 3)
+                      if f32 and "img_s_chip" in leg else None)
+               for name, leg in legs.items() if name != "exact"}
+    cascade_goodput = goodput.get("balanced")
+    int8_goodput = goodput.get("fast")
+    # the cascade's OWN property, host-independent: the balanced class
+    # retains the cheap stage's throughput (escalation overhead priced
+    # in) while the composed gate holds accuracy — "int8 goodput at
+    # f32 accuracy" as a ratio against the int8-only ceiling
+    efficiency = (round(cascade_goodput / int8_goodput, 3)
+                  if cascade_goodput and int8_goodput else None)
+    leg = {
+        "sizes": f"uniform[1..{max_size}]",
+        "seed": 13,
+        "coalesce_wait_us": wait_us,
+        "clients": clients,
+        "duration_s": duration,
+        "cheap_dtype": state.cheap_dtype,
+        "calibration": calibrated,
+        "stressed_threshold": stressed_threshold,
+        # the frontier's accuracy axis: MEASURED end-to-end argmax
+        # agreement vs the f32 baseline on the probe stream
+        "agreement_vs_f32": agreement,
+        "agreement_rows": total_rows,
+        "legs": legs,
+        "goodput_vs_f32": goodput,
+        # this host's warmup-measured full-bucket cost ratio — the
+        # frontier's physical ceiling; a bar above the ceiling is a
+        # host limitation, not a cascade regression, and the record
+        # keeps the two distinguishable (same provenance stance as
+        # --baseline's cross-silicon refusal)
+        "host_full_bucket_cost_ms": {
+            "float32": round(f32_costs[top] * 1e3, 3),
+            state.cheap_dtype: round(cheap_costs[top] * 1e3, 3)},
+        "host_compute_ceiling": compute_ceiling,
+        # ISSUE 17 acceptance: cascade goodput >= 1.5x the f32-only
+        # baseline at >= 0.995 measured end-to-end agreement
+        "goodput_bar": 1.5,
+        "goodput_bar_reachable": (compute_ceiling is not None
+                                  and compute_ceiling >= 1.5),
+        "goodput_ok": (cascade_goodput is not None
+                       and cascade_goodput >= 1.5),
+        # host-independent cascade property: balanced retains the
+        # int8-only ceiling's throughput (>= 0.9x) at composed
+        # accuracy — the escalation machinery itself costs ~nothing
+        # when the calibrated threshold says nothing needs escalating
+        "cascade_efficiency_vs_fast": efficiency,
+        "efficiency_ok": efficiency is not None and efficiency >= 0.9,
+        "agreement_ok": agreement["balanced"] >= 0.995,
+        "final_threshold": final_state.threshold,
+        # the variant + calibration warmup compiles, for the caller's
+        # whole-run recompile exclusion (same treatment as --swap's)
+        "variant_warmup_compile_events": warmup_events,
+    }
+    _mark(f"cascade frontier: goodput vs f32 {goodput} "
+          f"(agreement {agreement}, goodput_ok {leg['goodput_ok']}, "
+          f"efficiency vs fast {efficiency}, "
+          f"agreement_ok {leg['agreement_ok']})")
+    if not leg["goodput_bar_reachable"]:
+        _mark(f"cascade leg: the 1.5x goodput bar is UNREACHABLE on "
+              f"this host — the {state.cheap_dtype} compute ceiling "
+              f"is {compute_ceiling}x f32 (weight-only quantization "
+              "on XLA CPU, PARITY.md route disclosure); goodput_ok "
+              "reflects the host, not the cascade")
     return leg
 
 
@@ -2601,6 +2851,20 @@ def _serve(args) -> int:
                                          metrics, make_batcher, compiles,
                                          pipelined, clients, duration)
 
+    # Phase 4d (optional) — the confidence-gated cascade leg
+    # (ISSUE 17): the goodput-vs-accuracy frontier — f32-only, int8-
+    # only, and the calibrated cascade (plus a stressed operating
+    # point) on one seeded stream, with measured end-to-end agreement
+    # and per-phase escalation fractions. Also before the chaos leg so
+    # an injected storm can't contaminate the frontier; the int8
+    # variant + calibration warmups are excluded from the whole-run
+    # recompile check below.
+    cascade_leg = None
+    if args.cascade:
+        cascade_leg = _serve_cascade_leg(registry, router, factory,
+                                         metrics, make_batcher, compiles,
+                                         pipelined, clients, duration)
+
     # Phase 5 (optional) — the chaos leg (ISSUE 5 acceptance): seeded
     # fault schedule against the resilience stack, after the clean
     # phases so an injected storm can't contaminate the happy-path
@@ -2678,6 +2942,9 @@ def _serve(args) -> int:
     if dtype_sweep is not None:
         # and for the sweep variants' off-hot-path warmups
         recompiles -= dtype_sweep["variant_warmup_compile_events"]
+    if cascade_leg is not None:
+        # and for the cascade leg's int8 + calibration warmup
+        recompiles -= cascade_leg["variant_warmup_compile_events"]
     if lowlat_leg is not None:
         # and for the lowlat leg's megakernel variant warmup
         recompiles -= lowlat_leg["variant_warmup_compile_events"]
@@ -2770,6 +3037,13 @@ def _serve(args) -> int:
             # recompile counts (all 0), and the speedup-vs-f32 pair the
             # acceptance bar reads.
             "dtype_sweep": dtype_sweep,
+            # The cascade leg (ISSUE 17; None without --cascade): the
+            # goodput-vs-accuracy frontier (exact/fast/balanced + the
+            # stressed point), the calibrated threshold + gate record,
+            # measured end-to-end agreement vs f32, per-phase
+            # escalation fractions and recompile counts, and the
+            # goodput_ok/agreement_ok acceptance bars.
+            "cascade": cascade_leg,
             # The fleet block (ISSUE 6; None on single-replica runs):
             # per-replica provenance — which devices each replica owns
             # and whether the slices are disjoint silicon or logical

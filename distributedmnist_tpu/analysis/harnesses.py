@@ -129,7 +129,7 @@ class _RouteBatcher:
     def next_rid(self) -> int:
         return next(self._rid)
 
-    def submit(self, x, deadline_s=None, key=None) -> Future:
+    def submit(self, x, deadline_s=None, key=None, route=None) -> Future:
         fut: Future = Future()
         fut.trace_id = None
         self._q.put((fut, int(x.shape[0])))

@@ -149,6 +149,18 @@ RULES = {
         "coverage hole a chaos drill silently skips, or a stale "
         "weave. Coverage asserted as a static cross-check over the "
         "whole repo (ISSUE 12)"),
+    "DML016": (
+        "confidence-policy fork: margin read or hardcoded confidence "
+        "constant outside the cascade's calibrated threshold",
+        "the cascade's escalation decision is justified by exactly one "
+        "thing — the composed-accuracy gate that calibrated the "
+        "threshold (ISSUE 17, PARITY.md). A serve/ code path that "
+        "reads per-row softmax margins outside cascade.py, or "
+        "compares a margin against a numeric literal, has forked the "
+        "confidence policy: its routing decisions are judged by NO "
+        "gate and silently drift from the accuracy bar the operator "
+        "was promised. All margin decisions route through "
+        "cascade.threshold_of (the one accessor)"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -730,6 +742,57 @@ def _check_dml015(tree: ast.AST, rel: str, findings: list) -> None:
                 "measurements with a reason"))
 
 
+def _dml016_scope(rel: str) -> bool:
+    # cascade.py IS the confidence policy: it owns the margin math,
+    # the calibration search and the one threshold accessor.
+    return ((_in_serve_pkg(rel) or rel == "serve.py")
+            and os.path.basename(rel) != "cascade.py")
+
+
+def _check_dml016(tree: ast.AST, rel: str, findings: list) -> None:
+    """Confidence-policy forks outside cascade.py (ISSUE 17): a
+    softmax_margin() call — a per-row confidence read — or a margin-
+    named value compared against a numeric literal. The calibrated
+    threshold has exactly one accessor (cascade.threshold_of); a
+    hardcoded confidence bar anywhere else routes traffic by a policy
+    the composed-accuracy gate never judged."""
+
+    def _margin_named(node) -> bool:
+        if isinstance(node, ast.Name):
+            return "margin" in node.id.lower()
+        if isinstance(node, ast.Attribute):
+            return "margin" in node.attr.lower()
+        return False
+
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and ((isinstance(node.func, ast.Name)
+                      and node.func.id == "softmax_margin")
+                     or (isinstance(node.func, ast.Attribute)
+                         and node.func.attr == "softmax_margin"))):
+            findings.append(Finding(
+                rel, node.lineno, "DML016",
+                "softmax_margin() read outside cascade.py — per-row "
+                "confidence decisions belong to the cascade front, "
+                "gated by the one calibrated threshold "
+                "(cascade.threshold_of)"))
+        elif isinstance(node, ast.Compare):
+            operands = [node.left, *node.comparators]
+            if (any(_margin_named(n) for n in operands)
+                    and any(isinstance(n, ast.Constant)
+                            and isinstance(n.value, (int, float))
+                            and not isinstance(n.value, bool)
+                            for n in operands)):
+                findings.append(Finding(
+                    rel, node.lineno, "DML016",
+                    "margin compared against a hardcoded numeric "
+                    "constant — route the decision through the "
+                    "calibrated threshold accessor "
+                    "(cascade.threshold_of); a literal confidence bar "
+                    "is a policy fork no composed-accuracy gate "
+                    "judged"))
+
+
 def _check_dml013(tree: ast.AST, rel: str, findings: list) -> None:
     """Bare numeric literals reaching jitted call sites as traced
     (non-static) arguments — the weak-type cache-key split. Covers
@@ -1183,6 +1246,10 @@ def lint_source(text: str, rel: str) -> list:
     # DML015: dispatches outside the lane-deciding plumbing (ISSUE 14).
     if _dml015_scope(rel):
         _check_dml015(tree, rel, findings)
+    # DML016: confidence-policy forks outside the cascade's calibrated
+    # threshold (ISSUE 17).
+    if _dml016_scope(rel):
+        _check_dml016(tree, rel, findings)
     return findings
 
 

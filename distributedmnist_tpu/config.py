@@ -219,6 +219,18 @@ class Config:
     # p50, which is a per-deployment choice (the --lowlat bench leg
     # measures it).
     serve_fastlane: bool = False
+    # Confidence-gated model cascade (ISSUE 17, serve/cascade.py):
+    # serve_cascade fronts the pipeline with a two-stage dispatcher —
+    # the cheap parity-gated variant (int8 by default) answers every
+    # row whose softmax margin clears a confidence threshold calibrated
+    # on the held-out parity batch; uncertain rows escalate to the f32
+    # reference through the normal coalescing path. The cascade only
+    # takes traffic after an END-TO-END composed-accuracy gate (the
+    # cascade's answers must match f32 within the PARITY.md bar).
+    # serve_cascade_threshold overrides the calibrated threshold (same
+    # gate judges the override; a failing override refuses loudly).
+    serve_cascade: bool = False
+    serve_cascade_threshold: Optional[float] = None
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -421,6 +433,22 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "queue hand-offs, device-resident staging for "
                         "small buckets); contention falls back to the "
                         "coalescing path")
+    p.add_argument("--serve-cascade", dest="serve_cascade",
+                   action="store_true", default=None,
+                   help="[serving] confidence-gated model cascade "
+                        "(serve/cascade.py): the cheap parity-gated "
+                        "variant answers rows whose softmax margin "
+                        "clears a calibrated confidence threshold; "
+                        "uncertain rows escalate to the f32 reference. "
+                        "Promotable only after the end-to-end composed-"
+                        "accuracy gate passes (PARITY.md). Per-request "
+                        "X-Accuracy-Class picks fast|balanced|exact")
+    p.add_argument("--serve-cascade-threshold", type=float, default=None,
+                   help="[serving] override the calibrated cascade "
+                        "confidence threshold (margin in [0, 1]; rows "
+                        "below it escalate). The composed-accuracy "
+                        "gate still judges the override — a failing "
+                        "value refuses the cascade loudly")
     p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "

@@ -150,6 +150,14 @@ class _Request:
     # rows popped in the same drain dispatch ONCE — this request — and
     # fan the shared slice out to every rider's future at resolution.
     dups: list = field(default_factory=list)
+    # Pinned dispatch route (ISSUE 17): an infer_dtype the router must
+    # resolve for this request instead of the live default — the
+    # cascade's stage requests ("float32" for an escalation / the
+    # `exact` class, the cheap dtype for stage 1 / `fast`). None (every
+    # pre-cascade caller) keeps the live route. Drains are route-
+    # uniform: a batch runs ONE engine's program, so requests pinned to
+    # different routes never coalesce together.
+    route: Optional[str] = None
 
 
 class DynamicBatcher:
@@ -271,7 +279,8 @@ class DynamicBatcher:
         return next(self._rid)
 
     def submit(self, x, deadline_s: Optional[float] = None,
-               key: Optional[bytes] = None) -> Future:
+               key: Optional[bytes] = None,
+               route: Optional[str] = None) -> Future:
         """Enqueue up to max_batch rows; Future resolves to their logits.
         Raises Rejected past the queue watermark (overload shedding),
         ValueError for requests no single dispatch could ever carry,
@@ -280,7 +289,10 @@ class DynamicBatcher:
         passed — an expired request must cost zero queue and device
         work. A still-live deadline rides the request into the queue;
         the dispatch thread sheds it at pop time if it expires while
-        waiting (the 504-fast path — see _take_batch)."""
+        waiting (the 504-fast path — see _take_batch). `route` pins the
+        dispatch to a named infer_dtype (the cascade's stage requests);
+        routed requests take the coalescing path only — the fast lane's
+        resident program is compiled for the live route."""
         x = self.engine._as_images(x)
         n = x.shape[0]
         if n > self.max_batch:
@@ -301,7 +313,8 @@ class DynamicBatcher:
             key = hashlib.sha256(x.tobytes()).digest()
         req = _Request(x=x, n=n, t_enqueue=now, rid=next(self._rid),
                        deadline=deadline_s,
-                       key=key if self.dedup else None)
+                       key=key if self.dedup else None,
+                       route=route)
         tr = trace.active()
         if tr is not None:
             # Trace opened BEFORE the queue insert so the dispatch
@@ -328,7 +341,8 @@ class DynamicBatcher:
                 # request's in-flight slot, so the pipeline-depth bound
                 # holds across both lanes). Either half failing routes
                 # this submit down the ordinary coalescing path.
-                if (fastlane_eligible(self.fastlane, self._rows)
+                if (route is None
+                        and fastlane_eligible(self.fastlane, self._rows)
                         and self._slots.acquire(blocking=False)):
                     fast = True
                     with self._inflight_lock:
@@ -510,7 +524,12 @@ class DynamicBatcher:
         batch = []
         taken = 0
         now = time.monotonic()
-        while self._q and taken + self._q[0].n <= self.max_batch:
+        # Drains are route-uniform (ISSUE 17): one batch runs ONE
+        # engine program, so a head pinned to a different route than
+        # this drain's first request stays queued for the next cycle.
+        route = self._q[0].route
+        while (self._q and taken + self._q[0].n <= self.max_batch
+               and self._q[0].route == route):
             req = self._q.popleft()
             self._rows -= req.n
             if req.deadline is not None and now >= req.deadline:
@@ -655,7 +674,14 @@ class DynamicBatcher:
             # riders are not in this dispatch, so a request-sticky
             # draw cannot poison rows that never reach the engine
             failpoint("batch.dispatch", rids=rids)
-            return self.engine.dispatch([r.x for r in seg])
+            xs = [r.x for r in seg]
+            # Segments are route-uniform (_take_batch_locked), so the
+            # first request's pin speaks for the whole dispatch;
+            # bisection retries re-enter here and inherit it.
+            route = seg[0].route
+            if route is None:
+                return self.engine.dispatch(xs)
+            return self.engine.dispatch(xs, infer_dtype=route)
         finally:
             trace.end_span(sp)
 

@@ -308,7 +308,9 @@ class CacheFront:
                 getattr(self.router, "live_infer_dtype",
                         lambda: None)())
 
-    def submit(self, x, deadline_s: Optional[float] = None) -> Future:
+    def submit(self, x, deadline_s: Optional[float] = None,
+               route: Optional[str] = None,
+               route_label: Optional[str] = None) -> Future:
         """Cache-or-collapse-or-dispatch. Returns a Future resolving to
         the request's (n, 10) logits:
 
@@ -321,6 +323,12 @@ class CacheFront:
         - **leading miss**: dispatched through the batcher as usual
           (the batcher owns its trace), with the result cached on
           completion unless the computing version no longer matches.
+
+        `route` pins the dispatch to a named infer_dtype (the
+        cascade's stage requests); `route_label` (defaulting to the
+        route) replaces the live dtype in the cache key, so a pinned
+        stage's bytes are keyed — and only ever served — under the
+        precision that computed them, never the live route's label.
         """
         x = self.router._as_images(x)
         n = x.shape[0]
@@ -337,7 +345,12 @@ class CacheFront:
         if version is None:
             # warming / drained of versions: nothing to key on; the
             # pipeline's NoLiveModel 503 path is authoritative
-            return self.batcher.submit(x, deadline_s=deadline_s)
+            return self.batcher.submit(x, deadline_s=deadline_s,
+                                       route=route)
+        if route_label is None:
+            route_label = route
+        if route_label is not None:
+            infer_dtype = route_label
         key = content_key(version, infer_dtype, x)
         cache = self.cache
         tr = trace.active()
@@ -408,7 +421,7 @@ class CacheFront:
             return self._resolve_hit(hit, n, t0, deadline_s)
         if not leading:
             return follower.future
-        return self._lead(flight, x, deadline_s)
+        return self._lead(flight, x, deadline_s, route)
 
     def _resolve_hit(self, entry: _Entry, n: int, t0: float,
                      deadline_s: Optional[float]) -> Future:
@@ -441,13 +454,14 @@ class CacheFront:
         fut.set_result(np.array(entry.logits))
         return fut
 
-    def _lead(self, flight: _Flight, x, deadline_s) -> Future:
+    def _lead(self, flight: _Flight, x, deadline_s,
+              route: Optional[str] = None) -> Future:
         """Dispatch the leader through the batcher. The leader's OWN
         future is the batcher's (its trace, version tag and error
         semantics are untouched); the flight resolves from it."""
         try:
             bf = self.batcher.submit(x, deadline_s=deadline_s,
-                                     key=flight.key[3])
+                                     key=flight.key[3], route=route)
         except BaseException as e:
             # Rejected / DeadlineExceeded / stopped batcher: the flight
             # never got a computation — followers that slipped in

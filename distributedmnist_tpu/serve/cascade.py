@@ -1,0 +1,421 @@
+"""Confidence-gated model cascade (ISSUE 17): int8 goodput at f32
+accuracy.
+
+PAPERS.md cites Clipper for batching/admission; this module is its
+model-SELECTION layer. The cheap variant (int8, or the megakernel where
+gated) answers every request first; rows whose softmax margin (top-1
+minus top-2 probability) clears a CALIBRATED confidence threshold are
+served as-is, and the uncertain remainder is re-submitted to the f32
+reference THROUGH THE NORMAL COALESCING PATH — an escalation is just a
+request, so the DP batch former, the bounded in-flight window, cache
+keying and bisection semantics all hold unchanged. Clockwork's
+predictability argument prices the decision: both stages run
+pre-compiled, shape-stable programs whose costs are already in the
+bucket cost table, so a cascade never compiles anything.
+
+The threshold is not a config knob: it is CALIBRATED per version on the
+registry's held-out parity batch (calibrate below) — the smallest
+escalation set whose COMPOSED accuracy (escalated rows answered by f32,
+the rest by the cheap variant) matches f32 within the PARITY.md
+agreement bar, with every known-disagreeing row escalated. That is the
+END-TO-END cascade-accuracy gate: a cascade is only promotable when the
+composition passes, exactly like a single variant must pass its parity
+gate. The one calibrated threshold accessor is `threshold_of` — lint
+DML016 refuses any other serve-side code path that reads per-row
+margins or hardcodes a confidence constant.
+
+Request surface (serve.py `X-Accuracy-Class`):
+
+    fast      cheap-variant only — int8 latency, int8 accuracy
+    balanced  the cascade — cheap answers confident rows, f32 the rest
+    exact     f32 only — bypasses the cheap stage entirely
+
+CascadeFront sits in front of the CacheFront (or bare batcher),
+submit-shaped. Composed (balanced) results insert into the prediction
+cache under the dedicated `cascade:<dtype>` route label, and the two
+stages ride the normal per-dtype cache labels — a cheap-only answer can
+therefore never be served to an `exact`-class request (ISSUE 17
+satellite; the class-confusion test pins it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from distributedmnist_tpu.serve import trace
+
+# The per-request accuracy classes serve.py's X-Accuracy-Class header
+# selects (400 on anything else).
+ACCURACY_CLASSES = ("fast", "balanced", "exact")
+
+# Composed results are cached under this route-label prefix: distinct
+# from every single-dtype label, so a cascade answer can never alias a
+# cheap-only or f32-only entry.
+CASCADE_LABEL_PREFIX = "cascade:"
+
+
+def cascade_label(cheap_dtype: str) -> str:
+    """The prediction-cache route label composed results live under."""
+    return CASCADE_LABEL_PREFIX + cheap_dtype
+
+
+def softmax_margin(logits) -> np.ndarray:
+    """Per-row confidence margin: softmax(top-1) - softmax(top-2),
+    float64 in [0, 1]. Pure host numpy — the margin read happens on
+    result bytes already fetched, so the cascade adds no traced jit
+    keys (the compile-surface auditor's universe stays closed)."""
+    z = np.asarray(logits, dtype=np.float64)
+    z = z - z.max(axis=-1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=-1, keepdims=True)
+    top2 = np.partition(p, -2, axis=-1)[..., -2:]
+    return top2[..., 1] - top2[..., 0]
+
+
+def _composed(margins, agree, threshold: float) -> tuple:
+    """(composed agreement, escalation fraction) at a given threshold:
+    rows with margin < threshold are answered by the reference (always
+    agree with it); the rest keep the cheap answer."""
+    esc = margins < threshold
+    composed = float(np.mean(np.where(esc, True, agree)))
+    return composed, float(np.mean(esc))
+
+
+def calibrate(ref_logits, cheap_logits, min_agreement: float,
+              threshold: Optional[float] = None,
+              max_escalation: float = 0.5) -> dict:
+    """Calibrate (or, with `threshold` given, validate) the cascade's
+    confidence threshold on the held-out parity batch — the END-TO-END
+    cascade-accuracy gate.
+
+    Search rule: sort rows by cheap-stage margin ascending; escalating
+    the k lowest-margin rows yields a composed agreement of
+    (k + agreements among the rest) / n. The calibrated k is the
+    smallest that (a) clears `min_agreement` AND (b) escalates every
+    row the cheap stage got WRONG on this batch (low margin correlates
+    with, but does not equal, disagreement — the gate must not leave a
+    known disagreement un-escalated), capped at `max_escalation`·n
+    (past half the batch the cascade is slower than f32 and the gate
+    should refuse rather than quietly serve a worse-than-baseline
+    route). The threshold is the midpoint between the k-th and
+    (k+1)-th sorted margins, then the record's numbers are re-measured
+    at that REALIZED threshold (margin ties can shrink the escalated
+    set). passed=False (with why) when no threshold under the cap
+    reaches the bar.
+
+    With `threshold` given (a promote-time operator override) the
+    search is skipped and the same composed gate judges that value —
+    serve.py maps a refusal to 409."""
+    ref = np.asarray(ref_logits)
+    cheap = np.asarray(cheap_logits)
+    if ref.shape != cheap.shape:
+        raise ValueError(
+            f"logit shapes differ: reference {ref.shape} vs cheap "
+            f"{cheap.shape}")
+    n = ref.shape[0]
+    margins = softmax_margin(cheap)
+    agree = ref.argmax(-1) == cheap.argmax(-1)
+    base = float(np.mean(agree))
+    source = "calibrated"
+    if threshold is None:
+        order = np.argsort(margins, kind="stable")
+        ms = margins[order]
+        ag = agree[order]
+        # composed_k[k]: agreement when exactly the k lowest-margin
+        # rows escalate (they all agree by construction)
+        suffix = np.concatenate([np.cumsum(ag[::-1])[::-1], [0.0]])
+        composed_k = (np.arange(n + 1) + suffix) / n
+        meets = np.nonzero(composed_k >= min_agreement)[0]
+        k_bar = int(meets[0]) if meets.size else n
+        wrong = np.nonzero(~ag)[0]
+        k_full = int(wrong[-1]) + 1 if wrong.size else 0
+        k_cap = int(np.floor(max_escalation * n))
+        k = min(max(k_bar, k_full), k_cap)
+        if k <= 0:
+            threshold = 0.0
+        elif k >= n:
+            threshold = float(np.nextafter(ms[-1], np.inf))
+        elif ms[k - 1] == ms[k]:
+            # tie across the cut: a strict `< threshold` rule cannot
+            # split it, so the realized escalation set is smaller
+            threshold = float(ms[k])
+        else:
+            threshold = float((ms[k - 1] + ms[k]) / 2.0)
+    else:
+        source = "override"
+        threshold = float(threshold)
+    composed, esc_frac = _composed(margins, agree, threshold)
+    why = None
+    if composed < min_agreement:
+        why = (f"composed argmax agreement {composed:.4f} < "
+               f"{min_agreement} at threshold {threshold:.4f} "
+               f"(escalating {esc_frac:.1%} of {n} rows, cap "
+               f"{max_escalation:.0%}; cheap-only agreement {base:.4f})")
+    return {
+        "passed": why is None,
+        "why": why,
+        "threshold": threshold,
+        "rows": int(n),
+        "base_agreement": round(base, 6),
+        "composed_agreement": round(composed, 6),
+        "escalation_fraction": round(esc_frac, 6),
+        "min_agreement": min_agreement,
+        "max_escalation": max_escalation,
+        "source": source,
+    }
+
+
+@dataclasses.dataclass
+class CascadeState:
+    """A version's calibrated cascade: which cheap variant answers
+    first, the one threshold every margin read routes through
+    (threshold_of — lint DML016), and the calibration record the
+    cascade-accuracy gate produced."""
+
+    cheap_dtype: str
+    threshold: float
+    calibration: dict
+
+    def describe(self) -> dict:
+        return {
+            "cheap_dtype": self.cheap_dtype,
+            "threshold": round(self.threshold, 6),
+            "calibration": self.calibration,
+        }
+
+
+def threshold_of(state: CascadeState) -> float:
+    """THE calibrated confidence threshold accessor. Every serve-side
+    margin comparison must route through this one value (lint DML016):
+    a hardcoded confidence constant would silently desynchronize the
+    escalation rule from the gate that proved the composition
+    accurate."""
+    return float(state.threshold)
+
+
+class CascadeFront:
+    """Submit-shaped cascade layer over the CacheFront (or the bare
+    batcher): partitions cheap-stage results by calibrated margin and
+    re-submits the uncertain slice to f32 through the normal coalescing
+    path. Stage-2 submission happens inside stage 1's done-callback,
+    which the batcher runs BEFORE the stage-1 segment leaves its
+    in-flight count — so "pending==0 and inflight==0" still proves a
+    drained pipeline with the cascade in front.
+
+    With no calibrated cascade on the live version (warming, or a
+    promote to an uncascaded version) every class degrades to the plain
+    live route — counted in metrics, never an error: the transient
+    window between promote and re-calibration must shed accuracy
+    guarantees loudly, not availability."""
+
+    # serve.py's handler keys off this marker (engine doubles and the
+    # cache front don't have it) to accept X-Accuracy-Class.
+    is_cascade_front = True
+
+    def __init__(self, inner, batcher, router, registry, metrics=None,
+                 cache=None, default_class: str = "balanced"):
+        from distributedmnist_tpu.serve.cache import CacheFront
+
+        if default_class not in ACCURACY_CLASSES:
+            raise ValueError(
+                f"unknown default accuracy class {default_class!r} "
+                f"(expected one of {ACCURACY_CLASSES})")
+        self.inner = inner
+        self.batcher = batcher
+        self.router = router
+        self.registry = registry
+        self.metrics = metrics
+        self.cache = cache
+        self.default_class = default_class
+        self._inner_labeled = isinstance(inner, CacheFront)
+
+    # -- engine-shaped proxies (bench drain predicate, serve.py) ----------
+
+    def pending_rows(self) -> int:
+        return self.inner.pending_rows()
+
+    def inflight_batches(self) -> int:
+        return self.inner.inflight_batches()
+
+    def stop(self, drain: bool = True) -> None:
+        self.inner.stop(drain=drain)
+
+    # -- submission --------------------------------------------------------
+
+    def _plan(self):
+        """(live version, CascadeState) when the live version has a
+        calibrated cascade, else None."""
+        plan = getattr(self.registry, "cascade_plan", None)
+        return plan() if callable(plan) else None
+
+    def _inner_submit(self, x, deadline_s, route, label) -> Future:
+        """Route a stage through the inner layer: the CacheFront keys
+        the entry under `label` (so per-class populations never alias);
+        a bare batcher just pins the dispatch route."""
+        if self._inner_labeled:
+            return self.inner.submit(x, deadline_s=deadline_s,
+                                     route=route, route_label=label)
+        return self.inner.submit(x, deadline_s=deadline_s, route=route)
+
+    def submit(self, x, deadline_s: Optional[float] = None,
+               accuracy_class: Optional[str] = None) -> Future:
+        cls = accuracy_class or self.default_class
+        if cls not in ACCURACY_CLASSES:
+            raise ValueError(
+                f"unknown accuracy class {cls!r} (expected one of "
+                f"{ACCURACY_CLASSES})")
+        if self.metrics is not None:
+            self.metrics.record_cascade_class(cls)
+        plan = self._plan()
+        if plan is None:
+            # no calibrated cascade on the live version: the plain live
+            # route serves (degradation is counted, never silent)
+            if self.metrics is not None:
+                self.metrics.record_cascade_degraded()
+            return self.inner.submit(x, deadline_s=deadline_s)
+        version, state = plan
+        if cls == "exact":
+            return self._inner_submit(x, deadline_s, "float32", "float32")
+        if cls == "fast":
+            return self._inner_submit(x, deadline_s, state.cheap_dtype,
+                                      state.cheap_dtype)
+        return self._balanced(x, deadline_s, version, state)
+
+    def _balanced(self, x, deadline_s, version: str,
+                  state: CascadeState) -> Future:
+        x = self.router._as_images(x)
+        n = x.shape[0]
+        t0 = time.monotonic()
+        label = cascade_label(state.cheap_dtype)
+        rid = self.batcher.next_rid()
+        out: Future = Future()
+        tr = trace.active()
+        tid = None
+        if tr is not None:
+            tid = tr.start_request(rid, rows=n, deadline_s=deadline_s,
+                                   t0=t0)
+            out.trace_id = tid
+        key = epoch = None
+        if self.cache is not None:
+            from distributedmnist_tpu.serve.cache import content_key
+
+            key = content_key(version, label, x)
+            t_lk = time.monotonic()
+            cached = self.cache.lookup(key)
+            trace.add_span("cache.lookup", t_lk, time.monotonic(),
+                           rids=(rid,), hit=cached is not None)
+            if cached is not None:
+                t_hit = time.monotonic()
+                trace.add_span("cache.hit", t0, t_hit, rids=(rid,))
+                if tr is not None:
+                    tr.finish_request(rid, t_end=t_hit)
+                if self.metrics is not None:
+                    self.metrics.record_cache_hit(
+                        t_hit - t0, rows=n, version=version,
+                        infer_dtype=label)
+                out.version = version
+                out.set_result(cached)
+                return out
+            epoch = self.cache.epoch()
+        ctx = {"x": x, "n": n, "t0": t0, "rid": rid, "tid": tid,
+               "version": version, "state": state, "key": key,
+               "epoch": epoch, "deadline_s": deadline_s, "out": out,
+               "label": label}
+        try:
+            f1 = self._inner_submit(x, deadline_s, state.cheap_dtype,
+                                    state.cheap_dtype)
+        except BaseException:
+            # never admitted: nothing will ever finish this trace
+            if tr is not None:
+                tr.abort_request(rid)
+            raise
+        f1.add_done_callback(lambda f: self._stage1_done(ctx, f))
+        return out
+
+    def _stage1_done(self, ctx: dict, f1: Future) -> None:
+        try:
+            logits1 = f1.result()
+        except BaseException as e:
+            self._finish(ctx, error=e)
+            return
+        t1 = time.monotonic()
+        state = ctx["state"]
+        rid = ctx["rid"]
+        margins = softmax_margin(logits1)
+        esc = margins < threshold_of(state)
+        n_esc = int(esc.sum())
+        trace.add_span("cascade.stage", ctx["t0"], t1, rids=(rid,),
+                       stage=state.cheap_dtype, rows=ctx["n"],
+                       escalated=n_esc)
+        if self.metrics is not None:
+            self.metrics.record_cascade_stage(state.cheap_dtype,
+                                              ctx["n"])
+        v1 = getattr(f1, "version", None)
+        if n_esc == 0:
+            self._finish(ctx, logits=np.asarray(logits1), v1=v1, v2=v1)
+            return
+        trace.add_span("cascade.escalate", t1, t1, rids=(rid,),
+                       rows=n_esc, threshold=round(threshold_of(state),
+                                                   6))
+        if self.metrics is not None:
+            self.metrics.record_cascade_escalation(n_esc)
+        idx = np.nonzero(esc)[0]
+        try:
+            # the escalation inherits the request's deadline: under
+            # deadline pressure it is shed exactly like any request
+            f2 = self._inner_submit(ctx["x"][idx], ctx["deadline_s"],
+                                    "float32", "float32")
+        except BaseException as e:
+            self._finish(ctx, error=e)
+            return
+        f2.add_done_callback(
+            lambda f: self._stage2_done(ctx, np.asarray(logits1), idx,
+                                        v1, t1, f))
+
+    def _stage2_done(self, ctx: dict, logits1, idx, v1, t1,
+                     f2: Future) -> None:
+        try:
+            logits2 = f2.result()
+        except BaseException as e:
+            self._finish(ctx, error=e)
+            return
+        t2 = time.monotonic()
+        # reassembly is byte-stable: rows are independent through every
+        # engine forward (padding is zero rows the slice drops), so an
+        # escalated row's bytes are exactly the f32 single-dtype bytes
+        composed = np.array(logits1)
+        composed[idx] = logits2
+        trace.add_span("cascade.stage", t1, t2, rids=(ctx["rid"],),
+                       stage="float32", rows=int(len(idx)))
+        if self.metrics is not None:
+            self.metrics.record_cascade_stage("float32", int(len(idx)))
+        self._finish(ctx, logits=composed, v1=v1,
+                     v2=getattr(f2, "version", None))
+
+    def _finish(self, ctx: dict, logits=None, v1=None, v2=None,
+                error=None) -> None:
+        """Resolve the composed request: trace finishes BEFORE the
+        future resolves (the Server-Timing contract), and the composed
+        bytes insert into the cache only when both stages ran the same
+        version this request was keyed under (and the epoch still
+        matches — the cache itself re-checks under its lock)."""
+        out = ctx["out"]
+        version = v1 if v1 == v2 else None
+        if (error is None and ctx["key"] is not None
+                and version is not None
+                and version == ctx["version"]):
+            self.cache.insert(ctx["key"], logits, version,
+                              ctx["label"], epoch=ctx["epoch"])
+        tr = trace.active()
+        if tr is not None and ctx["tid"] is not None:
+            tr.finish_request(ctx["rid"], error=error)
+        if error is not None:
+            out.set_exception(error)
+            return
+        out.version = version
+        out.set_result(logits)

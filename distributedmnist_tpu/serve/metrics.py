@@ -126,6 +126,16 @@ class ServeMetrics:
             # coalesced at a glance.
             self._fastpath_dispatches = 0
             self._fastpath_rows = 0
+            # cascade accounting (ISSUE 17): per-class request counts
+            # (the X-Accuracy-Class split), per-stage dispatched rows
+            # (cheap dtype vs the f32 escalation stage), escalation
+            # volume, and requests that degraded to the plain live
+            # route because no calibrated cascade existed.
+            self._cascade_class = {}
+            self._cascade_stage_rows = {}
+            self._cascade_escalated_requests = 0
+            self._cascade_escalated_rows = 0
+            self._cascade_degraded = 0
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -186,6 +196,38 @@ class ServeMetrics:
         with self._lock:
             self._fastpath_dispatches += 1
             self._fastpath_rows += rows
+
+    def record_cascade_class(self, accuracy_class: str) -> None:
+        """One request entered the cascade front under this accuracy
+        class (fast / balanced / exact) — counted at submit, before
+        degrade/shed decisions, so the class split reflects demand."""
+        with self._lock:
+            self._cascade_class[accuracy_class] = \
+                self._cascade_class.get(accuracy_class, 0) + 1
+
+    def record_cascade_stage(self, stage: str, rows: int) -> None:
+        """Rows a cascade stage answered: the cheap dtype counts every
+        stage-1 row (all of a balanced request's), 'float32' only the
+        escalated slice — their ratio is the measured goodput story."""
+        with self._lock:
+            s = self._cascade_stage_rows.setdefault(
+                stage, {"rows": 0, "dispatches": 0})
+            s["rows"] += rows
+            s["dispatches"] += 1
+
+    def record_cascade_escalation(self, rows: int) -> None:
+        """One balanced request had `rows` rows under the calibrated
+        margin threshold and re-submitted them to f32."""
+        with self._lock:
+            self._cascade_escalated_requests += 1
+            self._cascade_escalated_rows += rows
+
+    def record_cascade_degraded(self) -> None:
+        """A cascade-front request served by the plain live route: no
+        calibrated cascade on the live version (warming, or a promote
+        to an uncascaded version). Loud, never an error."""
+        with self._lock:
+            self._cascade_degraded += 1
 
     def record_dedup(self, requests: int, rows: int) -> None:
         """Intra-batch dedup riders (ISSUE 10): identical rows inside
@@ -428,6 +470,14 @@ class ServeMetrics:
                 "dedup_rows": self._dedup_rows,
                 "fastpath_dispatches": self._fastpath_dispatches,
                 "fastpath_rows": self._fastpath_rows,
+                "cascade_class": dict(self._cascade_class),
+                "cascade_stage_rows": {
+                    st: dict(s)
+                    for st, s in self._cascade_stage_rows.items()},
+                "cascade_escalated_requests":
+                    self._cascade_escalated_requests,
+                "cascade_escalated_rows": self._cascade_escalated_rows,
+                "cascade_degraded": self._cascade_degraded,
                 "deadline_shed_requests": self._deadline_shed_requests,
                 "deadline_shed_rows": self._deadline_shed_rows,
                 "bisect_splits": self._bisect_splits,
@@ -446,6 +496,15 @@ class ServeMetrics:
             }
         lat_ms = {k: (round(v * 1e3, 3) if v is not None else None)
                   for k, v in percentiles(lat).items()}
+        # escalated rows over cheap-stage rows: the fraction of stage-1
+        # work the calibrated threshold sent on to f32 (None before any
+        # cascade traffic)
+        cheap_stage_rows = sum(
+            s["rows"] for st, s in c["cascade_stage_rows"].items()
+            if st != "float32")
+        cascade_escalation_fraction = (
+            round(c["cascade_escalated_rows"] / cheap_stage_rows, 4)
+            if cheap_stage_rows else None)
         occupancy = {
             str(b): {"batches": n, "rows": rows,
                      "occupancy": round(rows / (n * b), 4)}
@@ -540,6 +599,20 @@ class ServeMetrics:
                 "lane_fraction": (
                     round(c["fastpath_dispatches"] / c["requests"], 4)
                     if c["requests"] else None),
+            },
+            # the cascade's operating point (ISSUE 17): class demand,
+            # rows per stage, and what fraction of cheap-stage rows the
+            # calibrated threshold sent on to f32 — the knob the
+            # goodput-vs-accuracy frontier turns on
+            "cascade": {
+                "by_class": {k: v for k, v in
+                             sorted(c["cascade_class"].items())},
+                "stage_rows": {st: s for st, s in
+                               sorted(c["cascade_stage_rows"].items())},
+                "escalated_requests": c["cascade_escalated_requests"],
+                "escalated_rows": c["cascade_escalated_rows"],
+                "degraded_requests": c["cascade_degraded"],
+                "escalation_fraction": cascade_escalation_fraction,
             },
             "fleet": {
                 "failovers": c["failovers"],
@@ -696,6 +769,24 @@ _PROM_HELP = {
     "dmnist_serve_cache_expired_total":
         "Cache entries that aged past the TTL (expired hits count "
         "as misses).",
+    # confidence-gated cascade (ISSUE 17)
+    "dmnist_serve_cascade_requests_total":
+        "Requests entering the cascade front, by accuracy class "
+        "(X-Accuracy-Class: fast / balanced / exact).",
+    "dmnist_serve_cascade_stage_rows_total":
+        "Rows answered per cascade stage (the cheap dtype counts every "
+        "stage-1 row, float32 only the escalated slice).",
+    "dmnist_serve_cascade_escalated_requests_total":
+        "Balanced requests with at least one row under the calibrated "
+        "margin threshold (re-submitted to f32).",
+    "dmnist_serve_cascade_escalated_rows_total":
+        "Rows escalated to the f32 stage.",
+    "dmnist_serve_cascade_escalation_fraction":
+        "Escalated rows over cheap-stage rows: the fraction of "
+        "stage-1 work the calibrated threshold sent on to f32.",
+    "dmnist_serve_cascade_degraded_total":
+        "Cascade-front requests served by the plain live route "
+        "(no calibrated cascade on the live version).",
 }
 
 
@@ -844,6 +935,23 @@ def prometheus_exposition(snapshot: dict,
          [({}, fp.get("rows"))])
     emit("dmnist_serve_fastpath_lane_fraction", "gauge",
          [({}, fp.get("lane_fraction"))])
+    # confidence-gated cascade (ISSUE 17): class demand, per-stage
+    # rows, escalation volume and the degrade counter
+    ca = s.get("cascade", {})
+    emit("dmnist_serve_cascade_requests_total", "counter",
+         [({"accuracy_class": cls}, n)
+          for cls, n in sorted(ca.get("by_class", {}).items())])
+    emit("dmnist_serve_cascade_stage_rows_total", "counter",
+         [({"stage": st}, v.get("rows"))
+          for st, v in sorted(ca.get("stage_rows", {}).items())])
+    emit("dmnist_serve_cascade_escalated_requests_total", "counter",
+         [({}, ca.get("escalated_requests"))])
+    emit("dmnist_serve_cascade_escalated_rows_total", "counter",
+         [({}, ca.get("escalated_rows"))])
+    emit("dmnist_serve_cascade_escalation_fraction", "gauge",
+         [({}, ca.get("escalation_fraction"))])
+    emit("dmnist_serve_cascade_degraded_total", "counter",
+         [({}, ca.get("degraded_requests"))])
     if cache:
         emit("dmnist_serve_cache_hits_total", "counter",
              [({}, cache.get("hits"))])

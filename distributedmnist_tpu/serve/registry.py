@@ -158,6 +158,11 @@ class ModelVersion:
     # entry here; a variant only appears after add_variant() warmed it
     # and it either cleared or failed the parity gate.
     variants: dict = dataclasses.field(default_factory=dict)
+    # Calibrated confidence cascade over this version (ISSUE 17):
+    # serve/cascade.CascadeState once enable_cascade()'s end-to-end
+    # cascade-accuracy gate passed; None otherwise (the CascadeFront
+    # degrades every class to the plain live route).
+    cascade: Any = None
 
     def record_error(self, error: str) -> None:
         self.last_error = error
@@ -193,6 +198,10 @@ class ModelVersion:
             # verdict, per-dtype cost table, refusal reason (ISSUE 7)
             "variants": {dt: v.describe()
                          for dt, v in sorted(self.variants.items())},
+            # calibrated cascade state: cheap dtype, the one threshold,
+            # and the cascade-accuracy gate's record (ISSUE 17)
+            "cascade": (self.cascade.describe()
+                        if self.cascade is not None else None),
         }
 
 
@@ -775,6 +784,191 @@ class ModelRegistry:
         self.promote(version, infer_dtype=pick)
         return pick
 
+    # -- confidence cascade (ISSUE 17) -------------------------------------
+
+    def _cascade_gate(self, mv: ModelVersion, vi: VariantInfo,
+                      threshold: Optional[float] = None,
+                      max_escalation: float = 0.5) -> dict:
+        """The END-TO-END cascade-accuracy gate: run the held-out parity
+        batch through the f32 reference and the cheap variant, then
+        calibrate (or, with `threshold`, validate) the escalation
+        threshold so the COMPOSED answer matches f32 within the same
+        agreement bar a single variant must clear (PARITY.md)."""
+        from distributedmnist_tpu.serve import cascade as cascade_mod
+
+        x = self._parity_batch()
+        # lint: allow[DML015] admin-path cascade parity-gate calibration, never the request path
+        ref = mv.engines[0].infer(x)
+        # lint: allow[DML015] admin-path cascade parity-gate calibration, never the request path
+        cheap = vi.engines[0].infer(x)
+        return cascade_mod.calibrate(
+            np.asarray(ref), np.asarray(cheap),
+            min_agreement=PARITY_GATES[vi.infer_dtype][0],
+            threshold=threshold, max_escalation=max_escalation)
+
+    def _refresh_live_routes(self, mv: ModelVersion) -> None:
+        """Re-point the live route at `mv` (same engines, current
+        alternates) and flush the prediction cache: called after a
+        cascade state change so pinned routes and composed cache
+        entries never serve stale calibration."""
+        live_dt = getattr(self.router, "live_infer_dtype",
+                          lambda: None)()
+        engines = None
+        if live_dt not in (None, "float32"):
+            lvi = mv.variants.get(live_dt)
+            if lvi is not None and lvi.engines:
+                engines = lvi.engines
+        self._route_set("live", mv, engines=engines)
+
+    def enable_cascade(self, version: Optional[str] = None,
+                       cheap_dtype: str = "auto",
+                       threshold: Optional[float] = None,
+                       max_escalation: float = 0.5):
+        """Calibrate + gate a confidence cascade on `version` (default:
+        the live one). `cheap_dtype` 'auto' picks the cheapest
+        ALREADY-ready non-f32 variant by warmup-measured bucket cost
+        (building int8 when none exists yet); an explicit dtype warms +
+        parity-gates that variant via add_variant first. `threshold`
+        overrides the calibration search — the same composed gate
+        judges it (serve.py maps a refusal to 409). Returns the
+        CascadeState now active; raises RuntimeError when the gate
+        refuses (mv.cascade cleared, event logged) or the router cannot
+        resolve pinned routes (fleet front — the CascadeFront then
+        degrades every class to the plain live route)."""
+        from distributedmnist_tpu.serve import cascade as cascade_mod
+
+        with self._admin:
+            if not getattr(self.router, "supports_alternates", False):
+                raise RuntimeError(
+                    "router does not support pinned-route alternates "
+                    "(fleet front / engine double); a cascade needs "
+                    "per-dtype dispatch on one routing table")
+            if version is None:
+                version = self.router.live_version()
+                if version is None:
+                    raise RuntimeError(
+                        "no live version to enable a cascade on")
+            with self._state:
+                mv = self._get(version)
+                if mv.state not in ("ready", "live"):
+                    raise RuntimeError(
+                        f"version {version!r} is {mv.state!r}; a cascade "
+                        "hangs off a warmed version")
+                ready = {dt: vi for dt, vi in mv.variants.items()
+                         if vi.state == "ready" and vi.engines}
+            if cheap_dtype == "auto":
+                if ready:
+                    def price(vi) -> float:
+                        costs = vi.engine.bucket_costs()
+                        return (sum(costs.values()) if costs
+                                else float("inf"))
+                    cheap_dtype = min(ready,
+                                      key=lambda dt: price(ready[dt]))
+                else:
+                    cheap_dtype = "int8"
+            if cheap_dtype in (None, "float32"):
+                raise ValueError(
+                    "the cascade's cheap stage must be a low-precision "
+                    f"variant, not {cheap_dtype!r}")
+            # validates cheap_dtype against PARITY_GATES, warms + gates
+            # idempotently; a refused variant raises here
+            vi = self.add_variant(version, cheap_dtype)
+            rec = self._cascade_gate(mv, vi, threshold=threshold,
+                                     max_escalation=max_escalation)
+            if not rec["passed"]:
+                with self._state:
+                    mv.cascade = None
+                    self._events.append({
+                        "event": "cascade_refused", "version": version,
+                        "cheap_dtype": cheap_dtype,
+                        "reason": rec["why"],
+                        # lint: allow[DML004] wall-clock event stamp for operators
+                        "at": round(time.time(), 3)})
+                raise RuntimeError(
+                    f"cascade-accuracy gate REFUSED {cheap_dtype!r} "
+                    f"cascade of {version!r}: {rec['why']}")
+            state = cascade_mod.CascadeState(
+                cheap_dtype=cheap_dtype, threshold=rec["threshold"],
+                calibration=rec)
+            with self._state:
+                mv.cascade = state
+                self._events.append({
+                    "event": "cascade_enabled", "version": version,
+                    "cheap_dtype": cheap_dtype,
+                    "threshold": round(rec["threshold"], 6),
+                    "escalation_fraction": rec["escalation_fraction"],
+                    # lint: allow[DML004] wall-clock event stamp for operators
+                    "at": round(time.time(), 3)})
+            if self.router.live_version() == version:
+                # composed cache entries and pinned routes must reflect
+                # the NEW calibration the moment it exists
+                self._refresh_live_routes(mv)
+            log.info(
+                "registry: cascade enabled on %s (%s, threshold %.4f, "
+                "composed agreement %s, escalating %.1f%% of the "
+                "calibration batch)", version, cheap_dtype,
+                rec["threshold"], rec["composed_agreement"],
+                100 * rec["escalation_fraction"])
+            return state
+
+    def set_cascade_threshold(self, version: str, threshold: float):
+        """Re-gate `version`'s existing cascade at an operator-supplied
+        threshold override (promote's `cascade_threshold` body field).
+        The override is judged by the SAME composed-accuracy gate as a
+        calibrated threshold — there is no bypass; a refusal raises
+        RuntimeError (→ 409) and leaves the previous state intact."""
+        from distributedmnist_tpu.serve import cascade as cascade_mod
+
+        with self._admin:
+            with self._state:
+                mv = self._get(version)
+                state = mv.cascade
+            if state is None:
+                raise RuntimeError(
+                    f"version {version!r} has no cascade to "
+                    "re-threshold; enable one first")
+            vi = mv.variants.get(state.cheap_dtype)
+            if vi is None or vi.state != "ready" or not vi.engines:
+                raise RuntimeError(
+                    f"cascade variant {state.cheap_dtype!r} of "
+                    f"{version!r} is no longer ready; re-enable the "
+                    "cascade")
+            rec = self._cascade_gate(
+                mv, vi, threshold=threshold,
+                max_escalation=state.calibration.get("max_escalation",
+                                                     0.5))
+            if not rec["passed"]:
+                raise RuntimeError(
+                    f"cascade threshold override {threshold!r} REFUSED "
+                    f"for {version!r}: {rec['why']}")
+            new = cascade_mod.CascadeState(
+                cheap_dtype=state.cheap_dtype,
+                threshold=rec["threshold"], calibration=rec)
+            with self._state:
+                mv.cascade = new
+                self._events.append({
+                    "event": "cascade_threshold_set", "version": version,
+                    "threshold": round(rec["threshold"], 6),
+                    # lint: allow[DML004] wall-clock event stamp for operators
+                    "at": round(time.time(), 3)})
+            if self.router.live_version() == version:
+                self._refresh_live_routes(mv)
+            return new
+
+    def cascade_plan(self) -> Optional[tuple]:
+        """(live version, CascadeState) when the live version has a
+        calibrated cascade — the CascadeFront's per-submit read. None
+        otherwise (warming, uncascaded version): every accuracy class
+        then degrades to the plain live route, counted in metrics."""
+        live = self.router.live_version()
+        if live is None:
+            return None
+        with self._state:
+            mv = self._versions.get(live)
+            if mv is None or mv.cascade is None:
+                return None
+            return (live, mv.cascade)
+
     # -- routing -----------------------------------------------------------
 
     def set_cache(self, cache) -> None:
@@ -805,7 +999,21 @@ class ModelRegistry:
         engines = mv.engines if engines is None else engines
         target = (list(engines) if self.n_replicas > 1 else engines[0])
         if kind == "live":
-            self.router.set_live(target, mv.version)
+            if (self.n_replicas == 1
+                    and getattr(self.router, "supports_alternates",
+                                False)):
+                # Pinned-route table (ISSUE 17): the f32 base plus every
+                # parity-passing ready variant of THIS version, swapped
+                # atomically with the live target so a cascade stage
+                # dispatch can never straddle a promote boundary.
+                alternates = {"float32": mv.engines[0]}
+                for dt, vi in mv.variants.items():
+                    if vi.state == "ready" and vi.engines:
+                        alternates[dt] = vi.engines[0]
+                self.router.set_live(target, mv.version,
+                                     alternates=alternates)
+            else:
+                self.router.set_live(target, mv.version)
             if self._cache is not None:
                 self._cache.invalidate(reason=f"live -> {mv.version}")
         elif kind == "shadow":
@@ -814,15 +1022,31 @@ class ModelRegistry:
             self.router.set_canary(target, mv.version, fraction)
 
     def promote(self, version: str,
-                infer_dtype: Optional[str] = None) -> ModelVersion:
+                infer_dtype: Optional[str] = None,
+                cascade_threshold: Optional[float] = None
+                ) -> ModelVersion:
         """Atomic hot-swap: `version` (which must be warmed: 'ready' or
         already 'live') becomes the live target. The demoted version
         stays resident in state 'ready' — rollback is promote(old).
         `infer_dtype` routes one of the version's gated low-precision
         variants instead of the f32 base ('float32'/None = base); a
         variant that is not parity-passing ready is refused here too —
-        the gate has no promote-time bypass."""
-        with self._admin, self._state:
+        the gate has no promote-time bypass. `cascade_threshold`
+        re-gates the version's cascade at that override BEFORE the swap
+        (a refused override aborts the promote — the old live keeps
+        serving)."""
+        with self._admin:
+            if cascade_threshold is not None:
+                # validates via the composed-accuracy gate; RuntimeError
+                # (no cascade / gate refusal) propagates before any
+                # routing change. _admin is re-entrant; _state is not
+                # held here.
+                self.set_cascade_threshold(version, cascade_threshold)
+            return self._promote_locked(version, infer_dtype)
+
+    def _promote_locked(self, version: str,
+                        infer_dtype: Optional[str]) -> ModelVersion:
+        with self._state:
             mv = self._get(version)
             if mv.state not in ("ready", "live"):
                 raise RuntimeError(

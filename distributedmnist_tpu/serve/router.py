@@ -107,6 +107,13 @@ class Router:
     # buffer, a device batch and the live result), never an OOM.
     SHADOW_CAP = 64
 
+    # Capability flag the registry probes before wiring a cascade
+    # (ISSUE 17): routers that can resolve a pinned infer_dtype to a
+    # live-version alternate engine. Engine-shaped doubles and the
+    # fleet front (no per-dtype alternates) lack it, so enable_cascade
+    # refuses them instead of failing at dispatch time.
+    supports_alternates = True
+
     def __init__(self, max_batch: int, buckets: Sequence[int],
                  platform: str, n_chips: int = 1, metrics=None,
                  seed: int = 0, shadow_cap: Optional[int] = None,
@@ -128,6 +135,11 @@ class Router:
         self._live: Optional[_Target] = None
         self._canary: Optional[_Target] = None
         self._shadow: Optional[_Target] = None
+        # Pinned-route table for the LIVE version (ISSUE 17): maps
+        # infer_dtype -> warmed engine of that precision. Swapped
+        # atomically with _live in set_live so a pinned dispatch can
+        # never pair the new version's alternates with the old live.
+        self._alternates: dict = {}
         # Routing draws happen under the lock on the single dispatch
         # thread; seeded so canary/shadow sampling is reproducible in
         # tests and bench replays.
@@ -164,19 +176,34 @@ class Router:
                 f"{tuple(engine.buckets)} (max_batch {engine.max_batch}) "
                 "— all versions must share one compile geometry")
 
-    def set_live(self, engine, version: str) -> None:
+    def set_live(self, engine, version: str,
+                 alternates: Optional[dict] = None) -> None:
         """Atomic hot-swap: the next dispatched batch runs `version`;
         batches already in flight fetch from the engine their handle
-        captured. Clears a candidate role the promoted version held."""
+        captured. Clears a candidate role the promoted version held.
+        `alternates` maps infer_dtype -> warmed engine of THIS version
+        for pinned-route dispatches (the cascade's stage requests);
+        omitted, the table holds just the live engine under its own
+        dtype — pinning to anything else raises NoLiveModel."""
         self._check_compatible(engine)
+        if alternates is not None:
+            for alt in alternates.values():
+                self._check_compatible(alt)
+            alternates = dict(alternates)
+        else:
+            alternates = {
+                (getattr(engine, "infer_dtype", None) or "float32"):
+                    engine}
         with self._lock:
             prev = self._live.version if self._live else None
             self._live = _Target(engine, version)
+            self._alternates = alternates
             if self._canary and self._canary.version == version:
                 self._canary = None
             if self._shadow and self._shadow.version == version:
                 self._shadow = None
-        log.info("router: live version %s -> %s", prev, version)
+        log.info("router: live version %s -> %s (alternates: %s)",
+                 prev, version, sorted(alternates))
 
     def set_shadow(self, engine, version: str, fraction: float) -> None:
         self._check_compatible(engine)
@@ -239,6 +266,7 @@ class Router:
                 "shadow": ({"version": self._shadow.version,
                             "fraction": self._shadow.fraction}
                            if self._shadow else None),
+                "alternates": sorted(self._alternates),
             }
 
     def versions_in_route(self) -> set:
@@ -277,7 +305,13 @@ class Router:
 
     # -- the engine surface the batcher drives ----------------------------
 
-    def dispatch(self, x) -> RoutedHandle:
+    def dispatch(self, x, infer_dtype: Optional[str] = None
+                 ) -> RoutedHandle:
+        if infer_dtype is not None:
+            # Pinned route resolved BEFORE the seeded draws below so a
+            # cascade's stage dispatches never perturb the canary/
+            # shadow sampling sequence of interleaved live traffic.
+            return self._dispatch_pinned(x, infer_dtype)
         with self._lock:
             live, canary, shadow = self._live, self._canary, self._shadow
             route_draw = self._rng.random()
@@ -336,6 +370,34 @@ class Router:
                 finally:
                     trace.end_span(sp)
         return rh
+
+    def _dispatch_pinned(self, x, infer_dtype: str) -> RoutedHandle:
+        """Dispatch on the LIVE version's engine of a named precision
+        (the cascade's stage requests — `fast`/stage 1 pins the cheap
+        dtype, escalations and `exact` pin float32). Pinned dispatches
+        skip canary/shadow deliberately: a stage result must be
+        version-deterministic (its rows are compared/merged against the
+        sibling stage), and the candidate populations are defined over
+        live-routed coalesced dispatches only. A missing alternate is
+        NoLiveModel — status 503, systemic, so the batcher fails the
+        whole batch without futile bisection."""
+        with self._lock:
+            live = self._live
+            engine = self._alternates.get(infer_dtype)
+        if live is None:
+            raise NoLiveModel(
+                "no warmed model version is live (server warming?)")
+        if engine is None:
+            raise NoLiveModel(
+                f"no live {infer_dtype!r} route for version "
+                f"{live.version} (variant not promoted with the "
+                "cascade, or demoted by a re-gate)")
+        h = engine.dispatch(x)
+        return RoutedHandle(handle=h, engine=engine,
+                            version=live.version, n=h.n, bucket=h.bucket,
+                            canary=False, replica=self.replica,
+                            infer_dtype=getattr(engine, "infer_dtype",
+                                                None))
 
     def dispatch_fast(self, x) -> Optional[RoutedHandle]:
         """The fast lane's routed dispatch (ISSUE 14): resolve the live

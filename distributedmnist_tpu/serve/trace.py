@@ -87,6 +87,13 @@ Span name table (stage -> what it times -> mechanism):
     fastpath.admit          submit to lane dispatch begin (validation
                             + the atomic lane decision under the
                             queue lock)
+    cascade.stage           one cascade stage's whole window (ISSUE 17):
+                            submit (or escalation) to stage resolution;
+                            tagged stage=<dtype>, rows, and — on the
+                            cheap stage — how many rows escalated
+    cascade.escalate        zero-width escalation marker: the margin
+                            partition's decision point, tagged with the
+                            calibrated threshold and escalated rows
 """
 
 from __future__ import annotations
@@ -140,6 +147,13 @@ STAGE_OF = {
     # dispatch gap so attribution of a lane request has no residue
     "fastpath": ("fastpath", 8),
     "fastpath.admit": ("fastpath", 18),
+    # confidence-gated cascade (ISSUE 17): stage spans wrap the inner
+    # pipeline's spans at LOW priority (the nested queue/staging/fetch
+    # stages claim their own time; the cascade keeps the margin math +
+    # callback bookkeeping remainder); the escalate marker is
+    # zero-width, priority only for deterministic attribution order
+    "cascade.stage": ("cascade", 5),
+    "cascade.escalate": ("cascade", 6),
 }
 
 

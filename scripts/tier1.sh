@@ -8,13 +8,14 @@
 # driver compares across rounds.
 #
 # Marker note: the `-m 'not slow'` selection below INCLUDES the chaos,
-# fleet, quant, analysis, trace and cache suites (tests/conftest.py
-# registers the markers) — they are cheap and deterministic by design,
-# so the tier-1 gate covers fault injection, the replica fleet, the
-# quantized inference fast path, the concurrency sanitizer/lint, the
-# request tracer, and the prediction-cache front layer on every run.
-# `pytest -m quant` / `-m analysis` / `-m trace` / `-m cache` select
-# those suites alone.
+# fleet, quant, analysis, trace, cache and cascade suites
+# (tests/conftest.py registers the markers) — they are cheap and
+# deterministic by design, so the tier-1 gate covers fault injection,
+# the replica fleet, the quantized inference fast path, the
+# concurrency sanitizer/lint, the request tracer, the prediction-cache
+# front layer, and the confidence-gated cascade on every run.
+# `pytest -m quant` / `-m analysis` / `-m trace` / `-m cache` /
+# `-m cascade` select those suites alone.
 cd "$(dirname "$0")/.." || exit 1
 # The project lint runs FIRST (ISSUE 8): a lint regression (bare
 # threading primitive, unknown failpoint name, wall-clock timing, ...)

@@ -39,6 +39,10 @@ Two modes:
                          response then carries X-Trace-Id, and a
                          request sent with `X-Server-Timing: 1` gets a
                          Server-Timing stage breakdown on its response
+                         the optional X-Accuracy-Class header picks
+                         the accuracy/latency operating point under
+                         --serve-cascade: fast|balanced|exact (400 on
+                         anything else, or when no cascade is serving)
     GET  /healthz        real state: {"ok", "state":
                          warming|running|draining, "live_version",
                          "pending_rows", "inflight_batches",
@@ -122,6 +126,24 @@ additionally collapses identical rows inside one coalesced drain
 (dispatch once, fan out). /metrics exposes hit/miss/collapse/evict
 counters and the hit ratio (JSON `cache` block + dmnist_serve_cache_*
 Prometheus series).
+
+Confidence-gated cascade (ISSUE 17, serve/cascade.py):
+--serve-cascade fronts the pipeline with a two-stage dispatcher: the
+cheap parity-gated variant (int8 by default) answers every row whose
+softmax margin clears a confidence threshold calibrated on the held-out
+parity batch; uncertain rows escalate to the f32 reference THROUGH THE
+NORMAL COALESCING PATH (escalations are just requests — batch forming,
+in-flight window, cache keying and bisection semantics unchanged). The
+cascade takes traffic only after an end-to-end composed-accuracy gate:
+the cascade's final answers must match f32 within the PARITY.md bar.
+Per-request X-Accuracy-Class picks the operating point — "fast" (cheap
+variant only), "balanced" (the cascade; default), "exact" (f32 only);
+unknown values 400. --serve-cascade-threshold overrides the calibrated
+threshold (the same gate judges the override), and POST /models/promote
+accepts "cascade_threshold" for per-roll overrides. /healthz and GET
+/models expose the calibrated threshold + per-version cascade state;
+/metrics gains dmnist_serve_cascade_* series (per-class requests,
+per-stage rows, escalation fraction).
 
 Fast lane (ISSUE 14, serve/batcher.py + engine.dispatch_fast):
 --serve-fastlane opens the single-request low-latency bypass — a
@@ -262,6 +284,15 @@ class ServerState:
             "rollbacks": len(rollbacks),
             "last_rollback": attempts[-1] if attempts else None,
         }
+        # Cascade state of the LIVE version (ISSUE 17): the calibrated
+        # confidence threshold, cheap stage dtype and gate verdict —
+        # None while warming or when no cascade is enabled. The fleet
+        # probe reading this learns whether "balanced" requests are
+        # actually cascading or degrading to the plain live route.
+        live_desc = next((v for v in desc["versions"]
+                          if isinstance(v, dict)
+                          and v.get("version") == live), None)
+        payload["cascade"] = (live_desc or {}).get("cascade")
         # Replica fleet state (ISSUE 6): per-replica health/load plus
         # the failover/hedge counters — the first thing to read after
         # an availability dip is WHICH replica was sick and whether the
@@ -348,7 +379,8 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 metrics_every: float, request_timeout: float,
                 warm, retry_after_cap_s: float = 30.0,
                 infer_dtype_choice: str = "float32",
-                front=None, cache=None) -> dict:
+                front=None, cache=None, cascade: bool = False,
+                cascade_threshold=None) -> dict:
     import concurrent.futures
     import math
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -357,6 +389,7 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                                             Rejected,
                                             prometheus_exposition)
     from distributedmnist_tpu.serve import trace as trace_lib
+    from distributedmnist_tpu.serve.cascade import ACCURACY_CLASSES
 
     max_body = registry.factory.max_batch * IMAGE_BYTES
     # The submit target: the prediction-cache front layer when
@@ -620,11 +653,37 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                                               f"{infer_dtype!r}; one of "
                                               f"{list(INFER_DTYPES)}"})
                     return
+            # Optional cascade-threshold override (ISSUE 17): re-gates
+            # the version's cascade at this margin BEFORE the swap.
+            # Malformed values are 400s here; a well-formed value the
+            # composed-accuracy gate refuses (or a version with no
+            # cascade) is a rule conflict below (409).
+            cascade_threshold = body.get("cascade_threshold")
+            if cascade_threshold is not None:
+                if mode != "live":
+                    self._send(400, {"error": "'cascade_threshold' only "
+                                              "applies to mode 'live'"})
+                    return
+                try:
+                    cascade_threshold = float(cascade_threshold)
+                except (TypeError, ValueError):
+                    self._send(400, {
+                        "error": "'cascade_threshold' must be a number, "
+                                 f"got {body.get('cascade_threshold')!r}"})
+                    return
+                if (not math.isfinite(cascade_threshold)
+                        or not 0.0 <= cascade_threshold <= 1.0):
+                    self._send(400, {
+                        "error": "'cascade_threshold' must be a finite "
+                                 "number in [0, 1], got "
+                                 f"{cascade_threshold!r}"})
+                    return
             try:
                 with admin_lock:
                     if mode == "live":
-                        mv = registry.promote(version,
-                                              infer_dtype=infer_dtype)
+                        mv = registry.promote(
+                            version, infer_dtype=infer_dtype,
+                            cascade_threshold=cascade_threshold)
                     elif mode == "shadow":
                         mv = registry.set_shadow(version, fraction)
                     else:
@@ -677,6 +736,30 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                                               "finite number > 0"})
                     return
                 deadline_s = time.monotonic() + budget_s
+            # Accuracy class (ISSUE 17): X-Accuracy-Class picks where
+            # this request sits on the goodput/accuracy frontier —
+            # "fast" = cheap variant only, "balanced" = the confidence
+            # cascade, "exact" = f32 reference only. Only meaningful
+            # when the cascade front is installed: a class header sent
+            # to a non-cascade server is a client config error and must
+            # fail loudly (400), never silently serve some other
+            # precision than the client asked for.
+            acc_hdr = self.headers.get("X-Accuracy-Class")
+            accuracy_class = None
+            if acc_hdr is not None:
+                accuracy_class = acc_hdr.strip().lower()
+                if accuracy_class not in ACCURACY_CLASSES:
+                    self._send(400, {
+                        "error": "X-Accuracy-Class must be one of "
+                                 f"{'|'.join(ACCURACY_CLASSES)}, got "
+                                 f"{acc_hdr!r}"})
+                    return
+                if not getattr(submit_to, "is_cascade_front", False):
+                    self._send(400, {
+                        "error": "X-Accuracy-Class requires the "
+                                 "confidence cascade; restart with "
+                                 "--serve-cascade"})
+                    return
             raw = self.rfile.read(length)
             x = np.frombuffer(raw, np.uint8).reshape(-1, IMAGE_BYTES)
             fut = None
@@ -710,7 +793,11 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # comes back already resolved (still version-tagged and
                 # X-Trace-Id'd), a collapsed miss shares its leader's
                 # computation, everything else flows to the batcher
-                fut = submit_to.submit(x, deadline_s=deadline_s)
+                if accuracy_class is not None:
+                    fut = submit_to.submit(x, deadline_s=deadline_s,
+                                           accuracy_class=accuracy_class)
+                else:
+                    fut = submit_to.submit(x, deadline_s=deadline_s)
                 logits = fut.result(timeout=(
                     request_timeout if budget_s is None
                     else min(request_timeout, budget_s)))
@@ -827,6 +914,25 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                         "SIGHUP reload: --serve-infer-dtype %s refused "
                         "on %s; float32 stays live for it",
                         infer_dtype_choice, mv.version)
+            # Re-enable the cascade on the new version (ISSUE 17): the
+            # new params recalibrate the confidence threshold and
+            # re-run the composed-accuracy gate from scratch — a
+            # checkpoint roll must never carry a stale threshold
+            # forward. A refusal leaves every accuracy class degrading
+            # to the plain live route, loudly.
+            if cascade:
+                try:
+                    with admin_lock:
+                        st = registry.enable_cascade(
+                            mv.version, threshold=cascade_threshold)
+                    log.info("SIGHUP reload: cascade re-gated on %s "
+                             "(cheap %s, threshold %.4g)", mv.version,
+                             st.cheap_dtype, st.threshold)
+                except Exception:
+                    log.exception(
+                        "SIGHUP reload: cascade refused on %s; accuracy "
+                        "classes degrade to the plain live route (see "
+                        "GET /models for the gate verdict)", mv.version)
 
         make_thread(target=run, name="serve-reload",
                     daemon=True).start()
@@ -907,6 +1013,13 @@ def main(argv=None) -> int:
     if (args.serve_cache_ttl_s is not None
             and args.serve_cache_ttl_s <= 0):
         p.error("--serve-cache-ttl-s must be > 0")
+    if args.serve_cascade_threshold is not None:
+        if not args.serve_cascade:
+            p.error("--serve-cascade-threshold requires --serve-cascade")
+        if not 0.0 <= args.serve_cascade_threshold <= 1.0:
+            # nan fails both comparisons, so it lands here too — a
+            # malformed threshold must never silently disable the gate
+            p.error("--serve-cascade-threshold must be in [0, 1]")
     if args.serve_faults is not None:
         # a malformed chaos schedule is a usage error NOW — it must
         # never boot a server that silently injects nothing
@@ -972,6 +1085,19 @@ def main(argv=None) -> int:
                  "dedup %s): hits skip the pipeline, identical "
                  "concurrent misses collapse", cfg.serve_cache_capacity,
                  "on" if cfg.serve_dedup else "off")
+    # The confidence-gated cascade front (ISSUE 17): wraps the submit
+    # target so per-request accuracy classes route through the cheap
+    # variant + escalation machinery. Wrapping is unconditional under
+    # --serve-cascade — until warm() calibrates and gates the cascade,
+    # the front degrades every class to the plain live route (metered
+    # as degraded, never an error).
+    if cfg.serve_cascade:
+        from distributedmnist_tpu.serve.cascade import CascadeFront
+        front = CascadeFront(front, batcher, router, registry,
+                             metrics=metrics, cache=cache)
+        log.info("confidence cascade REQUESTED: calibration + the "
+                 "composed-accuracy gate run at warmup; X-Accuracy-"
+                 "Class picks fast|balanced|exact per request")
     log.info("dispatch pipeline depth: %d; buckets %s",
              batcher.max_inflight, list(factory.buckets))
     state = ServerState()
@@ -1000,6 +1126,24 @@ def main(argv=None) -> int:
                     "--serve-infer-dtype %s refused; float32 stays "
                     "live (see GET /models variants for the parity "
                     "verdict)", cfg.serve_infer_dtype)
+        # Calibrate + gate the cascade (ISSUE 17): builds the cheap
+        # variant if needed, calibrates the confidence threshold on
+        # the held-out parity batch and runs the END-TO-END composed-
+        # accuracy gate. A refusal leaves the plain live route serving
+        # every accuracy class — loud here, verdict in GET /models.
+        if cfg.serve_cascade:
+            try:
+                st = registry.enable_cascade(
+                    mv.version, threshold=cfg.serve_cascade_threshold)
+                log.info("confidence cascade ACTIVE on %s: cheap stage "
+                         "%s, threshold %.4g (%s)", mv.version,
+                         st.cheap_dtype, st.threshold,
+                         st.calibration.get("source", "calibrated"))
+            except Exception:
+                log.exception(
+                    "--serve-cascade refused on %s; every accuracy "
+                    "class serves the plain live route (see GET "
+                    "/models for the gate verdict)", mv.version)
 
     try:
         if args.port is None:
@@ -1017,7 +1161,10 @@ def main(argv=None) -> int:
                                       cfg.serve_retry_after_cap_s),
                                   infer_dtype_choice=(
                                       cfg.serve_infer_dtype),
-                                  front=front, cache=cache)
+                                  front=front, cache=cache,
+                                  cascade=cfg.serve_cascade,
+                                  cascade_threshold=(
+                                      cfg.serve_cascade_threshold))
     finally:
         batcher.stop()
     # Sanitizer verdict AFTER stop() (DMNIST_SANITIZE=1 runs): a

@@ -79,6 +79,15 @@ def pytest_configure(config):
         "(scripts/tier1.sh notes the inclusion)")
     config.addinivalue_line(
         "markers",
+        "cascade: confidence-gated cascade test (serve/cascade.py: "
+        "margin math, threshold calibration + the composed-accuracy "
+        "gate, the CascadeFront partition/escalate/reassemble path, "
+        "registry cascade lifecycle, accuracy-class/cache isolation); "
+        "cheap and deterministic, runs in tier-1 under the serve "
+        "sanitizer fixture — `-m cascade` selects just this suite "
+        "(scripts/tier1.sh notes the inclusion)")
+    config.addinivalue_line(
+        "markers",
         "trace: request-tracing test (serve/trace.py: span trees, "
         "sampling/exemplar retention, Chrome export, stage "
         "attribution, the /trace + Prometheus surfaces); cheap and "

@@ -1,0 +1,735 @@
+"""Confidence-gated model cascade (ISSUE 17, serve/cascade.py): the
+softmax-margin math, the threshold calibration search + the END-TO-END
+composed-accuracy gate (pass, refuse, and override paths), the
+CascadeFront's partition/escalate/reassemble pipeline (byte-stable
+against the single-dtype routes, asserted on stubs AND real engines),
+accuracy-class cache isolation (a cheap-only answer must never be
+served to an `exact` request), escalation under deadline pressure,
+poison-bisection with the cascade in front (ledger exact), the
+registry's cascade lifecycle (enable/threshold-set/promote-override/
+refusal), and the DML016 confidence-policy lint.
+
+Every test runs under the conftest serve sanitizer; the suite carries
+the `cascade` marker (tier-1 runs it; `-m cascade` selects it alone)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import (DeadlineExceeded, DynamicBatcher,
+                                        ResiliencePolicy, ServeMetrics)
+from distributedmnist_tpu.serve import cascade as cascade_lib
+from distributedmnist_tpu.serve.cache import (CacheFront, PredictionCache,
+                                              content_key)
+from distributedmnist_tpu.serve.cascade import (ACCURACY_CLASSES,
+                                                CascadeFront, CascadeState,
+                                                cascade_label, calibrate,
+                                                softmax_margin,
+                                                threshold_of)
+from tests.test_serve_batcher import StubEngine, _rows
+
+pytestmark = pytest.mark.cascade
+
+
+# -- margin math -----------------------------------------------------------
+
+
+def test_softmax_margin_shape_range_and_shift_invariance(rng):
+    logits = rng.normal(size=(32, 10)) * 3
+    m = softmax_margin(logits)
+    assert m.shape == (32,)
+    assert np.all((m >= 0) & (m <= 1))
+    # margins depend on logit GAPS only: a per-row shift (exactly what
+    # the stub engines' route offsets apply) must not move them
+    np.testing.assert_allclose(softmax_margin(logits + 123.0), m,
+                               atol=1e-12)
+
+
+def test_softmax_margin_extremes():
+    confident = np.zeros((1, 10)); confident[0, 3] = 30.0
+    assert softmax_margin(confident)[0] > 0.999
+    uniform = np.ones((1, 10)) * 7.0
+    assert softmax_margin(uniform)[0] == pytest.approx(0.0, abs=1e-12)
+
+
+# -- calibration + the composed-accuracy gate ------------------------------
+
+
+def _ref_logits(n):
+    """Reference answers: argmax 0 on every row."""
+    out = np.zeros((n, 10), np.float64)
+    out[:, 0] = 5.0
+    return out
+
+
+def _cheap_with(n, wrong_low_margin=(), wrong_high_margin=()):
+    """Cheap-stage logits agreeing with _ref_logits except on the given
+    rows: `wrong_low_margin` rows disagree with a tiny margin (the
+    escalatable kind), `wrong_high_margin` rows disagree CONFIDENTLY
+    (no threshold short of escalate-everything catches them)."""
+    out = np.zeros((n, 10), np.float64)
+    out[:, 0] = 4.0 + np.linspace(0, 1, n)   # distinct margins per row
+    for i in wrong_low_margin:
+        out[i] = 0.0
+        out[i, 1] = 0.05                     # argmax 1, margin ~0.005
+    for i in wrong_high_margin:
+        out[i] = 0.0
+        out[i, 1] = 30.0                     # argmax 1, margin ~1
+    return out
+
+
+def test_calibrate_perfect_agreement_needs_no_escalation():
+    rec = calibrate(_ref_logits(16), _cheap_with(16), 0.995)
+    assert rec["passed"] and rec["why"] is None
+    assert rec["threshold"] == 0.0
+    assert rec["base_agreement"] == 1.0
+    assert rec["composed_agreement"] == 1.0
+    assert rec["escalation_fraction"] == 0.0
+    assert rec["source"] == "calibrated"
+
+
+def test_calibrate_escalates_exactly_the_uncertain_disagreements():
+    ref, cheap = _ref_logits(16), _cheap_with(16,
+                                              wrong_low_margin=(2, 9))
+    rec = calibrate(ref, cheap, 0.995)
+    assert rec["passed"], rec
+    # 14/16 base agreement is under the bar; the two wrong rows carry
+    # the lowest margins, so the search lands just above them
+    assert rec["base_agreement"] == pytest.approx(14 / 16)
+    assert rec["composed_agreement"] == 1.0
+    assert rec["escalation_fraction"] == pytest.approx(2 / 16)
+    margins = softmax_margin(cheap)
+    esc = margins < rec["threshold"]
+    assert set(np.nonzero(esc)[0]) == {2, 9}
+
+
+def test_calibrate_refuses_when_cap_or_bar_unreachable():
+    # a CONFIDENT disagreement is invisible to any margin threshold
+    # short of escalate-everything, and escalate-everything is capped
+    ref = _ref_logits(16)
+    cheap = _cheap_with(16, wrong_high_margin=(5,))
+    rec = calibrate(ref, cheap, 0.995, max_escalation=0.5)
+    assert not rec["passed"]
+    assert rec["why"]
+    # an unreachable bar refuses even with perfect agreement
+    rec = calibrate(ref, _cheap_with(16), 1.01)
+    assert not rec["passed"]
+
+
+def test_calibrate_override_is_judged_by_the_same_gate():
+    ref, cheap = _ref_logits(16), _cheap_with(16,
+                                              wrong_low_margin=(2, 9))
+    # escalate-nothing override: base agreement 14/16 fails the bar
+    rec = calibrate(ref, cheap, 0.995, threshold=0.0)
+    assert not rec["passed"] and rec["source"] == "override"
+    assert rec["threshold"] == 0.0
+    # escalate-everything override: composed == f32, passes
+    rec = calibrate(ref, cheap, 0.995, threshold=1.0)
+    assert rec["passed"] and rec["source"] == "override"
+    assert rec["composed_agreement"] == 1.0
+    assert rec["escalation_fraction"] == 1.0
+
+
+def test_threshold_accessor_and_describe():
+    st = CascadeState("int8", 0.25, {"passed": True})
+    assert threshold_of(st) == 0.25
+    d = st.describe()
+    assert d["cheap_dtype"] == "int8" and d["threshold"] == 0.25
+    assert cascade_label("int8") == "cascade:int8"
+
+
+# -- CascadeFront over stub engines ---------------------------------------
+
+
+class CascadeStubEngine(StubEngine):
+    """Route-pinnable StubEngine: dispatch() accepts the batcher's
+    pinned infer_dtype and fetch() adds a per-route offset to every
+    logit — which route computed a row is detectable by VALUE, while
+    neither argmax nor softmax margins move (an offset shifts whole
+    rows; margins are gap-only, asserted above)."""
+
+    OFFSETS = {"float32": 0.0, "int8": 500.0}
+    supports_alternates = True
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.route_log = []
+
+    def live_version(self):
+        return "v1"
+
+    def live_infer_dtype(self):
+        return "float32"
+
+    def dispatch(self, x, infer_dtype=None):
+        h = super().dispatch(x)
+        h.infer_dtype = infer_dtype or "float32"
+        h.version = "v1"
+        self.route_log.append(h.infer_dtype)
+        return h
+
+    def fetch(self, handle):
+        out = super().fetch(handle) / 100.0
+        return out + self.OFFSETS[handle.infer_dtype]
+
+
+class PlanStub:
+    """cascade_plan-shaped registry double: a settable (version,
+    CascadeState) plan, None = no calibrated cascade (degrade)."""
+
+    def __init__(self, state=None, version="v1"):
+        self.state = state
+        self.version = version
+
+    def cascade_plan(self):
+        return None if self.state is None else (self.version, self.state)
+
+
+def _state(threshold, cheap_dtype="int8"):
+    return CascadeState(cheap_dtype, threshold,
+                        {"passed": True, "source": "test"})
+
+
+def _stub_front(engine, state, metrics=None, cache=None, **batcher_kw):
+    b = DynamicBatcher(engine, max_wait_us=1000, queue_depth=1024,
+                       metrics=metrics, **batcher_kw).start()
+    reg = PlanStub(state)
+    inner = (CacheFront(b, engine, cache, metrics=metrics)
+             if cache is not None else b)
+    front = CascadeFront(inner, b, engine, reg, metrics=metrics,
+                         cache=cache)
+    return front, b, reg
+
+
+def test_unknown_accuracy_class_raises(rng):
+    eng = CascadeStubEngine()
+    front, b, _ = _stub_front(eng, _state(0.5))
+    try:
+        with pytest.raises(ValueError, match="accuracy class"):
+            front.submit(_rows(rng, 2), accuracy_class="cheapest")
+        assert eng.calls == []            # refused before any dispatch
+    finally:
+        b.stop()
+
+
+def test_no_plan_degrades_to_live_route_and_is_counted(rng):
+    metrics = ServeMetrics()
+    eng = CascadeStubEngine()
+    front, b, _ = _stub_front(eng, None, metrics=metrics)
+    try:
+        x = _rows(rng, 3)
+        out = front.submit(x, accuracy_class="balanced").result(timeout=10)
+        # the plain (unpinned) live route computed it: f32 offset
+        np.testing.assert_array_equal(
+            out, x.reshape(3, -1)[:, :10].astype(np.float32) / 100.0)
+        snap = metrics.snapshot()["cascade"]
+        assert snap["degraded_requests"] == 1
+        assert dict(snap["by_class"])["balanced"] == 1
+    finally:
+        b.stop()
+
+
+def test_exact_and_fast_pin_their_routes(rng):
+    eng = CascadeStubEngine()
+    front, b, _ = _stub_front(eng, _state(0.5))
+    try:
+        x = _rows(rng, 4)
+        exact = front.submit(x, accuracy_class="exact").result(timeout=10)
+        fast = front.submit(x, accuracy_class="fast").result(timeout=10)
+        base = x.reshape(4, -1)[:, :10].astype(np.float32) / 100.0
+        np.testing.assert_array_equal(exact, base)
+        np.testing.assert_array_equal(fast, base + 500.0)
+        assert eng.route_log == ["float32", "int8"]
+    finally:
+        b.stop()
+
+
+def test_balanced_no_escalation_single_stage(rng):
+    metrics = ServeMetrics()
+    eng = CascadeStubEngine()
+    # threshold 0: `margin < 0` escalates nothing — one cheap dispatch
+    front, b, _ = _stub_front(eng, _state(0.0), metrics=metrics)
+    try:
+        x = _rows(rng, 4)
+        out = front.submit(x, accuracy_class="balanced").result(timeout=10)
+        np.testing.assert_array_equal(
+            out, x.reshape(4, -1)[:, :10].astype(np.float32) / 100.0 + 500.0)
+        assert eng.route_log == ["int8"]
+        snap = metrics.snapshot()["cascade"]
+        assert snap["escalated_requests"] == 0
+        assert snap["escalation_fraction"] == 0.0
+        assert dict(snap["stage_rows"])["int8"]["rows"] == 4
+    finally:
+        b.stop()
+
+
+def test_balanced_partitions_by_margin_and_reassembles_byte_stable(rng):
+    metrics = ServeMetrics()
+    eng = CascadeStubEngine()
+    x = _rows(rng, 8)
+    base = x.reshape(8, -1)[:, :10].astype(np.float32) / 100.0
+    margins = softmax_margin(base + 500.0)   # == cheap-stage margins
+    thr = float(np.sort(margins)[4])         # strict <: rows 0..3 escalate
+    assert len(np.unique(margins)) == 8      # distinct, split is exact
+    front, b, _ = _stub_front(eng, _state(thr), metrics=metrics)
+    try:
+        out = front.submit(x, accuracy_class="balanced").result(timeout=10)
+        esc = margins < thr
+        assert int(esc.sum()) == 4
+        # escalated rows carry the f32 route's exact bytes, the rest
+        # the cheap route's — reassembly is row-exact
+        np.testing.assert_array_equal(out[esc], base[esc])
+        np.testing.assert_array_equal(out[~esc], base[~esc] + 500.0)
+        assert eng.route_log == ["int8", "float32"]
+        assert eng.calls == [8, 4]           # only the uncertain slice
+        snap = metrics.snapshot()["cascade"]
+        assert snap["escalated_requests"] == 1
+        assert snap["escalated_rows"] == 4
+        stage = dict(snap["stage_rows"])
+        assert stage["int8"]["rows"] == 8
+        assert stage["float32"]["rows"] == 4
+        assert snap["escalation_fraction"] == pytest.approx(0.5)
+    finally:
+        b.stop()
+
+
+def test_balanced_full_escalation_equals_exact(rng):
+    eng = CascadeStubEngine()
+    # threshold 1.0 escalates every finite-margin row
+    front, b, _ = _stub_front(eng, _state(1.0))
+    try:
+        x = _rows(rng, 5)
+        balanced = front.submit(
+            x, accuracy_class="balanced").result(timeout=10)
+        exact = front.submit(x, accuracy_class="exact").result(timeout=10)
+        np.testing.assert_array_equal(balanced, exact)
+    finally:
+        b.stop()
+
+
+def test_escalation_inherits_deadline_and_sheds(rng):
+    """Under deadline pressure the stage-2 re-submit is shed exactly
+    like any request: the gate holds stage 1 on the device past the
+    request's deadline, so the escalation arrives at the batcher
+    already expired — DeadlineExceeded, zero stage-2 device work."""
+    gate = threading.Event()
+    eng = CascadeStubEngine(gate=gate)
+    front, b, _ = _stub_front(eng, _state(1.0))   # escalate everything
+    try:
+        fut = front.submit(_rows(rng, 2), accuracy_class="balanced",
+                           deadline_s=time.monotonic() + 0.2)
+        assert eng.in_call.wait(timeout=10)   # stage 1 dispatched...
+        time.sleep(0.35)                      # ...and now overdue
+        gate.set()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        assert eng.route_log == ["int8"]      # stage 2 never dispatched
+    finally:
+        b.stop()
+
+
+def test_expired_at_submit_never_reaches_stage1(rng):
+    eng = CascadeStubEngine()
+    front, b, _ = _stub_front(eng, _state(1.0))
+    try:
+        with pytest.raises(DeadlineExceeded):
+            front.submit(_rows(rng, 2), accuracy_class="balanced",
+                         deadline_s=time.monotonic() - 0.01)
+        assert eng.calls == []
+    finally:
+        b.stop()
+
+
+def test_stage1_failure_fails_the_composed_future(rng):
+    eng = CascadeStubEngine()
+    front, b, _ = _stub_front(eng, _state(1.0))
+    try:
+        b.stop()                              # wedge the inner pipeline
+        with pytest.raises(RuntimeError):
+            front.submit(_rows(rng, 2),
+                         accuracy_class="balanced").result(timeout=10)
+    finally:
+        b.stop()
+
+
+# -- accuracy-class cache isolation (ISSUE 17 satellite) -------------------
+
+
+def test_cascade_results_cache_under_the_cascade_key(rng):
+    """Composed answers insert under the cascade route label; repeats
+    hit without device work, and the label keeps per-class populations
+    from aliasing."""
+    metrics = ServeMetrics()
+    eng = CascadeStubEngine()
+    cache = PredictionCache(64)
+    front, b, _ = _stub_front(eng, _state(0.0), metrics=metrics,
+                              cache=cache)
+    try:
+        x = _rows(rng, 3)
+        first = front.submit(x, accuracy_class="balanced").result(timeout=10)
+        # two entries: the stage-1 bytes under the plain "int8" label
+        # (the inner CacheFront's doing) and the COMPOSED bytes under
+        # the cascade label
+        assert cache.stats()["entries"] == 2
+        assert cache.lookup(content_key("v1", cascade_label("int8"),
+                                        x)) is not None
+        assert cache.lookup(content_key("v1", "int8", x)) is not None
+        calls_before = list(eng.calls)
+        again = front.submit(x, accuracy_class="balanced").result(timeout=10)
+        np.testing.assert_array_equal(again, first)
+        assert eng.calls == calls_before      # served from the cache
+    finally:
+        b.stop()
+
+
+def test_cheap_answer_is_never_served_to_an_exact_request(rng):
+    """The class-confusion test: a cascade (cheap-routed) entry and an
+    `exact` request for the SAME bytes live under different cache keys
+    — exact recomputes on the f32 route and gets f32 bytes."""
+    eng = CascadeStubEngine()
+    cache = PredictionCache(64)
+    front, b, _ = _stub_front(eng, _state(0.0), cache=cache)
+    try:
+        x = _rows(rng, 3)
+        balanced = front.submit(
+            x, accuracy_class="balanced").result(timeout=10)
+        exact = front.submit(x, accuracy_class="exact").result(timeout=10)
+        fast = front.submit(x, accuracy_class="fast").result(timeout=10)
+        base = x.reshape(3, -1)[:, :10].astype(np.float32) / 100.0
+        np.testing.assert_array_equal(exact, base)          # f32 bytes
+        np.testing.assert_array_equal(balanced, base + 500.0)
+        np.testing.assert_array_equal(fast, base + 500.0)
+        assert not np.array_equal(exact, balanced)
+        # three distinct keys: cascade label, plain int8 (stage 1 —
+        # which the `fast` request legitimately hit), plain f32; the
+        # exact request NEVER saw a cheap-routed byte
+        assert cache.stats()["entries"] == 3
+        assert eng.route_log == ["int8", "float32"]
+    finally:
+        b.stop()
+
+
+def test_stale_cascade_entry_is_invalidated_with_the_epoch(rng):
+    """A threshold change invalidates composed entries: bytes cached
+    under the OLD threshold must not survive into the new policy."""
+    eng = CascadeStubEngine()
+    cache = PredictionCache(64)
+    front, b, reg = _stub_front(eng, _state(0.0), cache=cache)
+    try:
+        x = _rows(rng, 2)
+        front.submit(x, accuracy_class="balanced").result(timeout=10)
+        assert cache.stats()["entries"] == 2   # stage-1 + composed
+        # what registry.set_cascade_threshold does on the live version
+        reg.state = _state(1.0)
+        cache.invalidate()
+        assert cache.lookup(content_key("v1", cascade_label("int8"),
+                                        x)) is None
+        out = front.submit(x, accuracy_class="balanced").result(timeout=10)
+        base = x.reshape(2, -1)[:, :10].astype(np.float32) / 100.0
+        np.testing.assert_array_equal(out, base)   # escalated under new
+    finally:
+        b.stop()
+
+
+# -- chaos: poison bisection with the cascade in front ---------------------
+
+
+class PoisonCascadeStub(CascadeStubEngine):
+    """CascadeStubEngine whose dispatch() raises for any cohort
+    containing a marked request (first pixel == 211) — the
+    resilience suite's content-deterministic poison, route-pinnable."""
+
+    def dispatch(self, x, infer_dtype=None):
+        parts = x if isinstance(x, (list, tuple)) else [x]
+        if any(np.asarray(p).flat[0] == 211 for p in parts):
+            self.calls.append(-sum(np.asarray(p).reshape(
+                -1, 784).shape[0] for p in parts))
+            raise RuntimeError("poison request in cohort")
+        return super().dispatch(x, infer_dtype=infer_dtype)
+
+
+def _poison_rows(n):
+    x = np.full((n, 28, 28, 1), 5, np.uint8)
+    x[0, 0, 0, 0] = 211
+    return x
+
+
+@pytest.mark.chaos
+def test_bisection_ledger_exact_with_cascade_on(rng):
+    """The chaos drill with the cascade in front: a poison request in
+    a coalesced cascade cohort is isolated by bisection, its cohort
+    siblings are rescued, and the ledger is EXACT — route-uniform
+    drains mean bisection sub-dispatches inherit the cascade's pinned
+    route, so the resilience machinery needs no cascade awareness."""
+    gate = threading.Event()
+    eng = PoisonCascadeStub(max_batch=16, gate=gate)
+    metrics = ServeMetrics()
+    b = DynamicBatcher(eng, max_wait_us=50_000, max_inflight=4,
+                       resilience=ResiliencePolicy(bisect=True),
+                       metrics=metrics).start()
+    front = CascadeFront(b, b, eng, PlanStub(_state(0.0)),
+                         metrics=metrics)
+    try:
+        first = front.submit(_rows(rng, 1), accuracy_class="balanced")
+        assert eng.in_call.wait(timeout=10)   # cohort forms at the gate
+        clean = [front.submit(_rows(rng, 2), accuracy_class="balanced")
+                 for _ in range(2)]
+        bad = front.submit(_poison_rows(2), accuracy_class="balanced")
+        clean.append(front.submit(_rows(rng, 3),
+                                  accuracy_class="balanced"))
+        gate.set()
+        assert first.result(timeout=10).shape == (1, 10)
+        with pytest.raises(RuntimeError, match="poison"):
+            bad.result(timeout=10)
+        for i, f in enumerate(clean):
+            assert f.result(timeout=10).shape[1] == 10, i
+        snap = metrics.snapshot()["resilience"]
+        assert snap["poison_isolated_requests"] == 1
+        assert snap["poison_isolated_rows"] == 2
+        assert snap["bisect_rescued_requests"] == 3
+        assert snap["bisect_rescued_rows"] == 7
+        assert snap["dispatch_error_requests"] == 0
+        # every dispatch (including bisection sub-dispatches) stayed on
+        # the cascade's pinned cheap route
+        assert set(eng.route_log) == {"int8"}
+    finally:
+        b.stop()
+
+
+# -- batcher: route-uniform drains ----------------------------------------
+
+
+def test_batcher_never_coalesces_across_routes(rng):
+    """One batch runs ONE engine program: requests pinned to different
+    routes must never share a drain (the cascade's correctness rests
+    on this, not on any cascade-aware batching)."""
+    gate = threading.Event()
+    eng = CascadeStubEngine(max_batch=64, gate=gate)
+    b = DynamicBatcher(eng, max_wait_us=50_000, max_inflight=2).start()
+    try:
+        first = b.submit(_rows(rng, 1))       # holds the pipeline
+        assert eng.in_call.wait(timeout=10)
+        futs = [b.submit(_rows(rng, 2), route="int8"),
+                b.submit(_rows(rng, 2), route="float32"),
+                b.submit(_rows(rng, 2), route="int8")]
+        gate.set()
+        for f in [first] + futs:
+            assert f.result(timeout=10).shape[1] == 10
+        # the queued trio drained as int8 / float32 / int8 segments —
+        # adjacent same-route requests may coalesce, different routes
+        # never do
+        assert eng.route_log[0] == "float32"  # the unpinned holder
+        assert len(eng.route_log) == 4
+        assert eng.route_log[1:] == ["int8", "float32", "int8"]
+    finally:
+        b.stop()
+
+
+# -- registry lifecycle over real engines ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def cascade_registry(eight_devices):
+    """A bootstrapped single-replica LeNet registry with a calibrated
+    int8 cascade and a batcher over its router (module-scoped: the
+    bucket compiles are the slow part)."""
+    import jax
+
+    from distributedmnist_tpu import models
+    from distributedmnist_tpu.parallel import make_mesh
+    from distributedmnist_tpu.serve.registry import (EngineFactory,
+                                                     ModelRegistry)
+
+    mesh = make_mesh(eight_devices[:1])
+    model = models.build("lenet", platform="cpu")
+    factory = EngineFactory(model, mesh, max_batch=8)
+    metrics = ServeMetrics()
+    router = factory.make_router(metrics=metrics)
+    registry = ModelRegistry(factory, router)
+    registry.bootstrap(seed=0)
+    state = registry.enable_cascade()        # auto -> builds + gates int8
+    batcher = DynamicBatcher(router, max_wait_us=500, queue_depth=256,
+                             metrics=metrics).start()
+    front = CascadeFront(batcher, batcher, router, registry,
+                         metrics=metrics)
+    yield front, batcher, registry, router, metrics, state
+    batcher.stop()
+
+
+def test_enable_cascade_calibrates_and_describes(cascade_registry):
+    front, _, registry, router, _, state = cascade_registry
+    assert state.cheap_dtype == "int8"
+    assert state.calibration["passed"] is True
+    assert state.calibration["composed_agreement"] >= 0.995
+    live = registry.live_version()
+    plan = registry.cascade_plan()
+    assert plan is not None and plan[0] == live and plan[1] is state
+    desc = registry.describe()
+    mv_desc = next(v for v in desc["versions"] if v["version"] == live)
+    assert mv_desc["cascade"]["cheap_dtype"] == "int8"
+    assert mv_desc["cascade"]["threshold"] == round(state.threshold, 6)
+    assert any(e["event"] == "cascade_enabled" for e in desc["events"])
+    # the cheap route is promoted as a pinned alternate
+    assert "int8" in desc["routes"]["alternates"]
+
+
+def test_real_engine_classes_and_partition(cascade_registry, rng):
+    """End-to-end over real engines: `exact` == the f32 engine's bytes,
+    `fast` == the int8 engine's, and a forced partial escalation
+    composes exactly those two — escalated rows byte-equal f32."""
+    front, _, registry, router, _, state = cascade_registry
+    live = registry.live_version()
+    x = rng.integers(0, 256, (8, 28, 28, 1)).astype(np.uint8)
+    exact = front.submit(x, accuracy_class="exact").result(timeout=60)
+    fast = front.submit(x, accuracy_class="fast").result(timeout=60)
+    # lint: allow[DML016] test fixture computes expected margins for the assertion
+    margins = softmax_margin(fast)
+    assert len(np.unique(margins)) == 8
+    thr = float(np.sort(margins)[4])
+    old = threshold_of(state)
+    registry.set_cascade_threshold(live, thr)
+    try:
+        out = front.submit(x, accuracy_class="balanced").result(timeout=60)
+        esc = margins < thr
+        assert 0 < int(esc.sum()) < 8
+        np.testing.assert_array_equal(out[esc], exact[esc])
+        np.testing.assert_array_equal(out[~esc], fast[~esc])
+    finally:
+        registry.set_cascade_threshold(live, old)
+
+
+def test_full_escalation_byte_equals_f32(cascade_registry, rng):
+    front, _, registry, _, _, state = cascade_registry
+    live = registry.live_version()
+    old = threshold_of(state)
+    registry.set_cascade_threshold(live, 1.0)
+    try:
+        x = rng.integers(0, 256, (6, 28, 28, 1)).astype(np.uint8)
+        balanced = front.submit(
+            x, accuracy_class="balanced").result(timeout=60)
+        exact = front.submit(x, accuracy_class="exact").result(timeout=60)
+        np.testing.assert_array_equal(balanced, exact)
+    finally:
+        registry.set_cascade_threshold(live, old)
+
+
+def test_threshold_set_refusal_keeps_previous_state(cascade_registry,
+                                                    monkeypatch):
+    front, _, registry, _, _, _ = cascade_registry
+    live = registry.live_version()
+    before = registry.cascade_plan()[1]
+    monkeypatch.setattr(
+        registry, "_cascade_gate",
+        lambda *a, **k: {"passed": False, "why": "forced refusal",
+                         "threshold": 0.9})
+    with pytest.raises(RuntimeError, match="forced refusal"):
+        registry.set_cascade_threshold(live, 0.9)
+    assert registry.cascade_plan()[1] is before   # state intact
+
+
+def test_promote_with_threshold_override_regates(cascade_registry):
+    """promote(cascade_threshold=...) re-gates BEFORE the swap; the
+    override lands atomically with the promote."""
+    front, _, registry, _, _, _ = cascade_registry
+    live = registry.live_version()
+    old = threshold_of(registry.cascade_plan()[1])
+    mv = registry.promote(live, cascade_threshold=1.0)
+    try:
+        assert mv.state == "live"
+        assert threshold_of(registry.cascade_plan()[1]) == 1.0
+        assert any(e["event"] == "cascade_threshold_set"
+                   for e in registry.events())
+    finally:
+        registry.set_cascade_threshold(live, old)
+
+
+def test_enable_cascade_refuses_float32_cheap_stage(cascade_registry):
+    front, _, registry, _, _, _ = cascade_registry
+    with pytest.raises(ValueError, match="low-precision"):
+        registry.enable_cascade(registry.live_version(),
+                                cheap_dtype="float32")
+
+
+# -- static activation calibration rides the variant build ----------------
+
+
+def test_int8_prep_carries_static_activation_scales(eight_devices):
+    """Satellite 1: the Pallas int8 route's activation scales are
+    calibrated once at build from the held-out batch (a 0-d f32 leaf
+    in the prepared tree), not recomputed per dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu import models
+    from distributedmnist_tpu.ops import fused
+    from distributedmnist_tpu.serve import quantize as quantize_lib
+
+    model = models.build("mlp", platform="cpu")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    calib = quantize_lib.calibration_batch()
+    prep, _ = quantize_lib.prepare_inference(
+        model, params, "int8", fused.PALLAS, calib_x=calib)
+    scale = prep["act_scale"]
+    assert np.asarray(scale).shape == ()
+    assert np.asarray(scale).dtype == np.float32
+    assert float(scale) > 0
+
+
+def test_calibration_batch_is_deterministic_and_covers_probes():
+    from distributedmnist_tpu.serve import quantize as quantize_lib
+
+    a = quantize_lib.calibration_batch()
+    b = quantize_lib.calibration_batch()
+    np.testing.assert_array_equal(a, b)
+    assert a.shape[0] == 128 + quantize_lib._CALIB_PROBE_ROWS
+    assert a.dtype == np.uint8
+
+
+# -- lint DML016: the confidence-policy fork rule --------------------------
+
+
+def _lint(src, rel="distributedmnist_tpu/serve/somefile.py"):
+    from distributedmnist_tpu.analysis import lint
+    return [f.rule for f in lint.lint_source(src, rel)
+            if f.rule == "DML016"]
+
+
+def test_dml016_flags_margin_reads_and_constants():
+    assert _lint("m = softmax_margin(logits)\n") == ["DML016"]
+    assert _lint("esc = margins < 0.3\n") == ["DML016"]
+    assert _lint("if row_margin >= 0.95:\n    pass\n") == ["DML016"]
+    assert _lint("esc = self.margin < 0.5\n") == ["DML016"]
+
+
+def test_dml016_allows_the_accessor_and_cascade_itself():
+    assert _lint("esc = margins < threshold_of(state)\n") == []
+    # cascade.py owns the policy; tests and non-serve code are out of
+    # scope entirely
+    src = "m = softmax_margin(x)\nesc = m < 0.5\n"
+    assert _lint(src, "distributedmnist_tpu/serve/cascade.py") == []
+    assert _lint(src, "tests/test_serve_cascade.py") == []
+    assert _lint(src, "distributedmnist_tpu/models.py") == []
+    # margin-free numeric compares in serve/ are untouched
+    assert _lint("ok = fraction < 0.5\n") == []
+
+
+def test_dml016_repo_is_clean():
+    """The serving tree itself holds no confidence-policy forks."""
+    import os
+
+    from distributedmnist_tpu.analysis import lint
+
+    root = lint.repo_root()
+    for rel in ["serve.py"] + [
+            os.path.join("distributedmnist_tpu", "serve", f)
+            for f in os.listdir(os.path.join(
+                root, "distributedmnist_tpu", "serve"))
+            if f.endswith(".py")]:
+        text = open(os.path.join(root, rel), encoding="utf-8").read()
+        findings = [f for f in lint.lint_source(text, rel.replace(
+            os.sep, "/")) if f.rule == "DML016"]
+        active, _ = lint.apply_allowlist(findings, text.splitlines())
+        assert not active, (rel, active)

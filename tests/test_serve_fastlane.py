@@ -617,7 +617,7 @@ def test_cache_front_ttl_expired_hit_recomputes():
         def next_rid(self):
             return 1
 
-        def submit(self, x, deadline_s=None, key=None):
+        def submit(self, x, deadline_s=None, key=None, route=None):
             self.submits += 1
             fut = Future()
             fut.trace_id = None

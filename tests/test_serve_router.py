@@ -359,7 +359,8 @@ def test_promote_clears_candidate_role(rng):
     r.set_canary(v2, "v2", fraction=0.5)
     r.set_live(v2, "v2")
     routes = r.routes()
-    assert routes == {"live": "v2", "canary": None, "shadow": None}
+    assert routes == {"live": "v2", "canary": None, "shadow": None,
+                      "alternates": ["float32"]}
 
 
 def test_fraction_validation():
