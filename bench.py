@@ -249,6 +249,17 @@ def main(argv=None) -> int:
                         "fractions, and recompile counts (must stay 0; "
                         "bars: cascade goodput >= 1.5x f32 at "
                         "agreement >= 0.995)")
+    p.add_argument("--multimodel", action="store_true", default=None,
+                   help="[serve] add the multi-tenant leg (ISSUE 18): "
+                        "boot MLP and LeNet in ONE process behind the "
+                        "global WFQ/EDF scheduler, measure the light "
+                        "tenant's p99 solo, then add a heavy burst "
+                        "tenant routed at the other model and report "
+                        "the light tenant's mixed p99 (bar: <= 1.5x "
+                        "solo), per-tenant SLO attainment, the "
+                        "dispatch-share/weight-share fairness ratios "
+                        "(bar: within [0.8, 1.25]), and the recompile "
+                        "count (must stay 0 across both phases)")
     p.add_argument("--baseline", default=None, metavar="BENCH_serve.json",
                    help="[serve] a prior BENCH_serve_r*.json to diff "
                         "against: prints a delta table and REFUSES "
@@ -318,6 +329,7 @@ def main(argv=None) -> int:
                    "--serve-cache-capacity": args.serve_cache_capacity,
                    "--dtype-sweep": args.dtype_sweep,
                    "--cascade": args.cascade,
+                   "--multimodel": args.multimodel,
                    "--baseline": args.baseline,
                    "--chaos": args.chaos,
                    "--trace": args.trace,
@@ -1524,6 +1536,248 @@ def _serve_cascade_leg(registry, router, factory, metrics, make_batcher,
     return leg
 
 
+def _serve_multimodel_leg(compiles, duration: float, rows: int) -> dict:
+    """The multi-tenant leg (ISSUE 18 acceptance): MLP and LeNet
+    resident in ONE process behind the global WFQ/EDF scheduler, on
+    their own catalog + scheduler (the main single-model stack stays
+    untouched). Phase A measures the light tenant's p99 alone; phase B
+    adds a heavy burst tenant routed at the OTHER model and re-measures
+    the light tenant under contention. Both tenants run window-kept
+    pumps (always backlogged) so the granted-row split is the
+    SCHEDULER's decision, not client pacing — the dispatch-share /
+    weight-share fairness ratio is meaningful only against sustained
+    demand. Bars recorded (not raised, the cascade leg's stance):
+    light mixed p99 <= 1.5x solo, both fairness ratios in [0.8, 1.25],
+    zero steady-state recompiles across both phases."""
+    import collections
+
+    import numpy as np
+
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.serve import ServeMetrics
+    from distributedmnist_tpu.serve.tenancy import build_tenancy
+
+    weights = {"light": 2.0, "heavy": 1.0}
+    # Quantum well BELOW the per-grant head costs: DRR shares converge
+    # to the weights only when affording a head takes multiple credit
+    # scans — a quantum that covers every head on its first visit
+    # degenerates to round-robin (grant frequency, not service time,
+    # would be equalized).
+    cfg = Config(
+        model="mlp", serve_models="mlp,lenet",
+        serve_tenants=(f"light:weight={weights['light']:g},"
+                       "deadline_ms=5000,model=mlp;"
+                       f"heavy:weight={weights['heavy']:g},"
+                       "model=lenet"),
+        serve_max_batch=16, serve_max_wait_us=500,
+        serve_tenant_quantum_us=200.0)
+    metrics = ServeMetrics()
+    boot_from = compiles.snapshot()
+    catalog, sched = build_tenancy(cfg, metrics=metrics)
+    lat = {"solo": [], "light": [], "heavy": []}
+    try:
+        for name in catalog.names():     # eager residency, as serve.py
+            catalog.ensure_live(name, seed=cfg.seed)
+        # The FULL boot compile delta, not the per-entry engine-warmup
+        # counters: building two models also compiles parity-gate and
+        # first-dispatch programs, and the whole-run recompile
+        # exclusion below must cover everything boot cost or the
+        # headline record mis-reports catalog warmup as steady-state
+        # recompiles.
+        warmup_compiles = compiles.snapshot() - boot_from
+        steady_from = compiles.snapshot()
+        _mark(f"multimodel leg: {catalog.names()} resident "
+              f"({warmup_compiles} warmup compiles); light solo "
+              f"{duration:.0f}s then +heavy burst {duration:.0f}s")
+
+        # The host's cross-model compute-contention ceiling: time an
+        # mlp dispatch alone, then with a continuous lenet storm
+        # sharing the silicon — ROUTER-direct, no queues, so the ratio
+        # is pure device contention, which no scheduler can remove.
+        # On shared chips (this CPU; logical replicas) the 1.5x p99
+        # bar is unreachable whenever the ceiling alone exceeds it —
+        # the record keeps host limits distinguishable from scheduler
+        # regressions (the cascade leg's goodput_bar_reachable
+        # stance).
+        probe_rng = np.random.default_rng(7)
+        xm = probe_rng.integers(0, 256, (8, 28, 28, 1), dtype=np.uint8)
+        xl = probe_rng.integers(0, 256, (16, 28, 28, 1),
+                                dtype=np.uint8)
+        mlp_router = catalog.get("mlp").router
+        lenet_router = catalog.get("lenet").router
+
+        def _median_infer_ms(n=30):
+            times = []
+            for _ in range(n):
+                t0 = time.monotonic()
+                mlp_router.infer(xm)
+                times.append(time.monotonic() - t0)
+            return float(np.median(times)) * 1e3
+
+        alone_ms = _median_infer_ms()
+        storm_stop = [False]
+
+        def _storm():
+            while not storm_stop[0]:
+                lenet_router.infer(xl)
+
+        storm = make_thread(target=_storm, name="bench-mm-storm",
+                            daemon=True)
+        storm.start()
+        try:
+            contended_ms = _median_infer_ms()
+        finally:
+            storm_stop[0] = True
+            storm.join()
+        contention_x = round(contended_ms / alone_ms, 3) if alone_ms \
+            else None
+        _mark(f"multimodel: host cross-model contention ceiling "
+              f"{contention_x}x (mlp {alone_ms:.2f} -> "
+              f"{contended_ms:.2f} ms under a lenet storm)")
+
+        errors: list = []
+
+        def pump(tenant, window, stop_at, lats, model=None):
+            rng = np.random.default_rng(sum(map(ord, tenant)))
+            x = rng.integers(0, 256, (rows, 28, 28, 1), dtype=np.uint8)
+            outstanding = collections.deque()
+            while time.monotonic() < stop_at:
+                try:
+                    while (len(outstanding) < window
+                           and time.monotonic() < stop_at):
+                        outstanding.append(
+                            (time.monotonic(),
+                             sched.submit(x, tenant=tenant,
+                                          model=model)))
+                    t0, fut = outstanding.popleft()
+                    fut.result(timeout=120)
+                    lats.append(time.monotonic() - t0)
+                except BaseException as e:
+                    errors.append(e)
+                    return
+            while outstanding:
+                t0, fut = outstanding.popleft()
+                try:
+                    fut.result(timeout=120)
+                    lats.append(time.monotonic() - t0)
+                except BaseException as e:
+                    errors.append(e)
+                    return
+
+        def phase(pumps):
+            threads = [make_thread(target=pump, args=spec,
+                                   name=f"bench-mm-{spec[0]}",
+                                   daemon=True)
+                       for spec in pumps]
+            granted0 = {t: s["granted_rows"] for t, s in
+                        sched.snapshot()["tenants"].items()}
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(
+                    "multimodel pump died; the fairness split would "
+                    "be measured against a degraded tenant") \
+                    from errors[0]
+            return {t: s["granted_rows"] - granted0[t] for t, s in
+                    sched.snapshot()["tenants"].items()}
+
+        def p99_ms(samples):
+            return (round(float(np.percentile(samples, 99)) * 1e3, 3)
+                    if samples else None)
+
+        # Phase A: the light tenant alone — its uncontended p99.
+        phase([("light", 8, time.monotonic() + duration, lat["solo"])])
+        solo_p99 = p99_ms(lat["solo"])
+        # Phase B: the same light pump + a heavy burst at the OTHER
+        # model, 8x the outstanding window — the latency-protection
+        # measurement (light keeps its real, low demand).
+        stop_at = time.monotonic() + duration
+        phase([("light", 8, stop_at, lat["light"]),
+               ("heavy", 64, stop_at, lat["heavy"])])
+        mixed_p99 = p99_ms(lat["light"])
+        # Phase C: BOTH tenants saturated (equal deep windows) on ONE
+        # shared model — the fairness measurement. The dispatch split
+        # is the scheduler's decision only where the tenants compete
+        # for the same bounded staging: the pacing cap keeps their
+        # backlogs in the per-tenant queues, and every row that
+        # reaches the device got there by a DRR grant. (Phase B's
+        # split reflects demand — light idles between round trips —
+        # and separate models dispatch in parallel, so neither phase B
+        # number is an arbitration signal.) lenet, the expensive
+        # model, so head costs dwarf the quantum.
+        stop_at = time.monotonic() + duration
+        granted = phase([("light", 64, stop_at, [], "lenet"),
+                         ("heavy", 64, stop_at, [], "lenet")])
+        recompiles = compiles.snapshot() - steady_from
+    finally:
+        sched.stop()
+
+    backlogged = {t: w for t, w in weights.items() if granted.get(t)}
+    total_rows = sum(granted[t] for t in backlogged) or 1
+    total_weight = sum(backlogged.values())
+    fairness = {}
+    for t, w in backlogged.items():
+        # one shared model in phase C: equal per-row cost, so the
+        # row share IS the service-time share DRR equalizes
+        share = granted[t] / total_rows
+        weight_share = w / total_weight
+        fairness[t] = {
+            "granted_rows": granted[t],
+            "dispatch_share": round(share, 4),
+            "weight_share": round(weight_share, 4),
+            "ratio": round(share / weight_share, 3),
+        }
+    degradation = (round(mixed_p99 / solo_p99, 3)
+                   if mixed_p99 and solo_p99 else None)
+    bt = metrics.snapshot()["by_tenant"]
+    leg = {
+        "models": ["mlp", "lenet"],
+        "weights": weights,
+        "duration_s_per_phase": duration,
+        "rows_per_request": rows,
+        "light_solo_p99_ms": solo_p99,
+        "light_mixed_p99_ms": mixed_p99,
+        "heavy_mixed_p99_ms": p99_ms(lat["heavy"]),
+        "light_p99_degradation_x": degradation,
+        # ISSUE 18 acceptance: a heavy burst degrades the light
+        # tenant's p99 by at most 1.5x its solo baseline. Reachable
+        # only where the two models don't contend for the same
+        # silicon — the probe above measured this host's floor, and
+        # on shared chips light_p99_ok reflects the host, not the
+        # scheduler (see host_contention_x).
+        "light_p99_bar": 1.5,
+        "host_contention_x": contention_x,
+        "light_p99_bar_reachable": (contention_x is not None
+                                    and contention_x <= 1.5),
+        "light_p99_ok": (degradation is not None
+                         and degradation <= 1.5),
+        "fairness_model": "lenet",
+        "fairness": fairness,
+        # and each backlogged tenant's dispatch share tracks its
+        # weight share within [0.8, 1.25]
+        "fairness_ok": all(0.8 <= f["ratio"] <= 1.25
+                           for f in fairness.values()),
+        "slo_attainment": {t: bt.get(t, {}).get("slo_attainment")
+                           for t in weights},
+        "max_skip_observed": sched.max_skip_observed,
+        "recompiles_after_warmup": recompiles,
+        "warmup_compile_events": warmup_compiles,
+    }
+    _mark(f"multimodel: light p99 {solo_p99} -> {mixed_p99} ms "
+          f"({degradation}x, ok {leg['light_p99_ok']}), fairness "
+          f"{ {t: f['ratio'] for t, f in fairness.items()} } "
+          f"(ok {leg['fairness_ok']}), {recompiles} recompiles")
+    if not leg["light_p99_bar_reachable"]:
+        _mark(f"multimodel leg: the 1.5x light-p99 bar is UNREACHABLE "
+              f"on this host — cross-model compute contention alone "
+              f"costs {contention_x}x on shared silicon (one XLA-CPU "
+              "device serves both models); light_p99_ok reflects the "
+              "host, not the scheduler")
+    return leg
+
+
 def _serve_zipf_leg(router, metrics, factory, make_batcher,
                     pipelined: int, clients: int, duration: float,
                     cache_on: bool = True,
@@ -2350,6 +2604,28 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
         "lowlat_p50_fastlane_ms": (
             (cur_d.get("lowlat") or {}).get("p50_fastlane_ms"),
             (base_d.get("lowlat") or {}).get("p50_fastlane_ms")),
+        # the cascade-frontier signals (ISSUE 17): measured end-to-end
+        # agreement of the balanced class vs the f32 baseline, the
+        # balanced-vs-int8-ceiling efficiency (host-independent), and
+        # the calibrated escalation fraction. None-vs-None when either
+        # round ran without --cascade — and like every other gated
+        # row, a gained/lost leg between rounds prints as prev/cur
+        # with no percentage rather than hiding the asymmetry.
+        "cascade_agreement": (
+            ((cur_d.get("cascade") or {}).get("agreement_vs_f32")
+             or {}).get("balanced"),
+            ((base_d.get("cascade") or {}).get("agreement_vs_f32")
+             or {}).get("balanced")),
+        "cascade_efficiency": (
+            (cur_d.get("cascade") or {}).get(
+                "cascade_efficiency_vs_fast"),
+            (base_d.get("cascade") or {}).get(
+                "cascade_efficiency_vs_fast")),
+        "cascade_escalation_rate": (
+            (((cur_d.get("cascade") or {}).get("legs")
+              or {}).get("balanced") or {}).get("escalation_fraction"),
+            (((base_d.get("cascade") or {}).get("legs")
+              or {}).get("balanced") or {}).get("escalation_fraction")),
         # the compile-surface provenance row (ISSUE 12): static key
         # count side by side; the fingerprint-set hash comparison is
         # appended below the table (hashes don't delta as percentages).
@@ -2865,6 +3141,16 @@ def _serve(args) -> int:
                                          metrics, make_batcher, compiles,
                                          pipelined, clients, duration)
 
+    # Phase 4e (optional) — the multi-tenant leg (ISSUE 18): MLP +
+    # LeNet behind the global WFQ/EDF scheduler on their own catalog,
+    # the light tenant's solo-vs-contended p99, the fairness ratios
+    # and per-tenant SLO attainment. Before the chaos leg for the same
+    # contamination reason; the catalog's two model warmups are
+    # excluded from the whole-run recompile check below.
+    multimodel_leg = None
+    if args.multimodel:
+        multimodel_leg = _serve_multimodel_leg(compiles, duration, rows)
+
     # Phase 5 (optional) — the chaos leg (ISSUE 5 acceptance): seeded
     # fault schedule against the resilience stack, after the clean
     # phases so an injected storm can't contaminate the happy-path
@@ -2945,6 +3231,9 @@ def _serve(args) -> int:
     if cascade_leg is not None:
         # and for the cascade leg's int8 + calibration warmup
         recompiles -= cascade_leg["variant_warmup_compile_events"]
+    if multimodel_leg is not None:
+        # and for the tenancy catalog's two per-model warmups
+        recompiles -= multimodel_leg["warmup_compile_events"]
     if lowlat_leg is not None:
         # and for the lowlat leg's megakernel variant warmup
         recompiles -= lowlat_leg["variant_warmup_compile_events"]
@@ -3044,6 +3333,13 @@ def _serve(args) -> int:
             # escalation fractions and recompile counts, and the
             # goodput_ok/agreement_ok acceptance bars.
             "cascade": cascade_leg,
+            # The multi-tenant leg (ISSUE 18; None without
+            # --multimodel): two models behind the global scheduler,
+            # light-tenant p99 solo vs under a heavy burst (bar:
+            # <= 1.5x), per-tenant dispatch-share/weight-share
+            # fairness ratios (bar: [0.8, 1.25]), SLO attainment, the
+            # observed DRR skip maximum, and the recompile count.
+            "multimodel": multimodel_leg,
             # The fleet block (ISSUE 6; None on single-replica runs):
             # per-replica provenance — which devices each replica owns
             # and whether the slices are disjoint silicon or logical

@@ -129,7 +129,8 @@ class _RouteBatcher:
     def next_rid(self) -> int:
         return next(self._rid)
 
-    def submit(self, x, deadline_s=None, key=None, route=None) -> Future:
+    def submit(self, x, deadline_s=None, key=None, route=None,
+               tags=None) -> Future:
         fut: Future = Future()
         fut.trace_id = None
         self._q.put((fut, int(x.shape[0])))
@@ -784,6 +785,179 @@ class FastlaneBatcherMachine(BatcherMachine):
             "(or was lost) across the lanes")
 
 
+# -- machine 6: global scheduler WFQ/EDF fairness (ISSUE 18) ---------------
+
+
+class _TenRouter:
+    """Router-shaped fake under the real GlobalScheduler: always
+    resident, empty cost table (dispatch pricing falls back to the
+    default per-row estimate — deterministic, schedule-independent)."""
+
+    @staticmethod
+    def live_version():
+        return "v1"
+
+    @staticmethod
+    def live_infer_dtype():
+        return "float32"
+
+    @staticmethod
+    def bucket_costs():
+        return {}
+
+    @staticmethod
+    def _as_images(x) -> np.ndarray:
+        return np.asarray(x, dtype=np.uint8)
+
+
+class _TenBatcher:
+    """Inline-resolving per-model queue fake: submit() returns an
+    already-resolved future (zero service time). The machine explores
+    the SCHEDULER's interleavings — admission vs grant loop vs admin
+    vs stop; the batcher's own races are BatcherMachine's job."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.rows_forwarded = 0
+
+    def submit(self, x, deadline_s=None, route=None, tags=None):
+        arr = np.asarray(x)
+        self.rows_forwarded += arr.shape[0]     # forward thread only
+        fut: Future = Future()
+        fut.set_result(np.zeros((arr.shape[0], 10), np.float32))
+        return fut
+
+    @staticmethod
+    def pending_rows() -> int:
+        return 0
+
+    def stop(self, drain: bool = True) -> None:
+        pass
+
+
+class SchedulerWFQMachine:
+    """Real GlobalScheduler (grant loop under the controller) over a
+    two-model fake catalog: a light weight-2 tenant and a heavy
+    bursty tenant racing concurrent submits, a live set_quota admin
+    call, and a draining stop(). The contract: every accepted future
+    resolves across stop, no client op is lost, per-tenant pending-row
+    accounting always matches the queues' actual contents, and the
+    DRR consecutive-skip starvation bound holds (bounded head-of-line
+    blocking — also asserted inside every grant)."""
+
+    name = "scheduler-wfq"
+    OPS = 7          # 2 light + 3 heavy + 2 deadlined light
+
+    def __init__(self):
+        self.sched = None
+        self.futs: list = []
+        self.refused: list = []
+
+    def run(self, ctl) -> None:
+        import time
+
+        from distributedmnist_tpu.serve.tenancy import (CatalogEntry,
+                                                        GlobalScheduler,
+                                                        ModelCatalog,
+                                                        SLOClass)
+
+        catalog = ModelCatalog()
+        for m in ("mlp", "lenet"):
+            catalog.add(CatalogEntry(
+                name=m, registry=None, router=_TenRouter(),
+                factory=types.SimpleNamespace(max_batch=8,
+                                              buckets=(4, 8),
+                                              platform="cpu"),
+                batcher=_TenBatcher(m)))
+        tenants = {
+            "light": SLOClass(name="light", weight=2.0),
+            "heavy": SLOClass(name="heavy", weight=1.0,
+                              model="lenet"),
+        }
+        self.sched = sched = GlobalScheduler(
+            catalog, tenants, quantum_s=0.001, tenant_queue_rows=64)
+        sched.start()
+
+        def client(tenant, rows, n_ops, use_deadline=False):
+            def body():
+                for _ in range(n_ops):
+                    try:
+                        dl = (time.monotonic() + 0.002
+                              if use_deadline else None)
+                        self.futs.append(sched.submit(
+                            np.zeros((rows, 4), np.uint8),
+                            tenant=tenant, deadline_s=dl))
+                    except Exception as e:
+                        # QuotaExceeded / Rejected (watermark) /
+                        # DeadlineExceeded (expired at the door) /
+                        # RuntimeError (stopped)
+                        self.refused.append(type(e).__name__)
+            return body
+
+        threads = [
+            ctl.spawn(client("light", 2, 2), "light"),
+            ctl.spawn(client("heavy", 6, 3), "heavy-burst"),
+            ctl.spawn(client("light", 1, 2, use_deadline=True),
+                      "light-deadlined"),
+            ctl.spawn(lambda: sched.set_quota("light", qps=1000.0,
+                                              burst=64.0), "admin"),
+        ]
+        for t in threads:
+            t.join()
+        sched.stop(drain=True)
+        for fut in list(self.futs):
+            await_future(ctl, fut, "tenant-result")
+
+    def invariant(self, ctl) -> None:
+        s = self.sched
+        if s is None:
+            return
+        if ctl.lock_free("tenancy.sched"):
+            qrows: dict = {}
+            for (t, _m), q in s._queues.items():
+                qrows[t] = qrows.get(t, 0) + sum(r.n for r in q)
+            for t, rows in s._pending_rows.items():
+                assert rows >= 0, (
+                    f"tenant {t} pending rows went negative: {rows}")
+                assert qrows.get(t, 0) == rows, (
+                    f"tenant {t} pending-row gauge {rows} disagrees "
+                    f"with queue contents {qrows.get(t, 0)} — torn "
+                    "admission/grant accounting")
+            self._check_skip_bound(s)
+
+    @staticmethod
+    def _check_skip_bound(s) -> None:
+        from distributedmnist_tpu.serve import scheduler as policy
+
+        if s._max_head_cost_s <= 0:
+            return
+        weights = [c.weight for c in s._classes.values()]
+        bound = policy.drr_skip_bound(len(s._ring),
+                                      s._max_head_cost_s,
+                                      s.quantum_s, min(weights))
+        assert s.max_skip_observed <= bound, (
+            f"WFQ starvation: a tenant was passed over "
+            f"{s.max_skip_observed} consecutive grants "
+            f"(bound {bound})")
+
+    def final(self, ctl) -> None:
+        s = self.sched
+        unresolved = [f for f in self.futs if not f.done()]
+        assert not unresolved, (
+            f"{len(unresolved)} admitted future(s) never resolved "
+            "across stop(drain=True)")
+        assert len(self.futs) + len(self.refused) == self.OPS, (
+            "client ops lost: "
+            f"{len(self.futs)} futures + {len(self.refused)} refusals "
+            f"!= {self.OPS}")
+        assert all(rows == 0 for rows in s._pending_rows.values()), (
+            f"pending rows at drain: {s._pending_rows}")
+        assert all(not q for q in s._queues.values()), (
+            "non-empty tenant queue at drain")
+        self._check_skip_bound(s)
+        self.invariant(ctl)
+
+
 def _batcher_nodrain() -> BatcherMachine:
     return BatcherMachine(drain=False)
 
@@ -801,4 +975,9 @@ MACHINES = {
     # semaphore.
     "batcher-fastlane": FastlaneBatcherMachine,
     "fleet": FleetMachine,
+    # the global scheduler's WFQ/EDF fairness vs racing admission,
+    # quota admin and stop (ISSUE 18): accepted futures all resolve,
+    # queue accounting never tears, head-of-line blocking stays under
+    # the asserted DRR skip bound.
+    "scheduler-wfq": SchedulerWFQMachine,
 }

@@ -161,6 +161,19 @@ RULES = {
         "gate and silently drift from the accuracy bar the operator "
         "was promised. All margin decisions route through "
         "cascade.threshold_of (the one accessor)"),
+    "DML017": (
+        "tenancy scheduler state mutated outside the scheduler's "
+        "named lock",
+        "the global scheduler's admission/fairness accounting (token "
+        "buckets, DRR deficits and skip counters, per-tenant queues "
+        "and pending-row totals, the ring cursor) is one atomically-"
+        "consistent decision state: a lock-free mutation of any of it "
+        "in serve/ tears a grant decision mid-flight — quota double-"
+        "spends, deficit drift that silently breaks the asserted "
+        "starvation bound, queues whose row accounting disagrees with "
+        "their contents. DML010's inference needs >= 2 locked sites "
+        "to learn a guard; these fields are DECLARED guarded (ISSUE "
+        "18), so even a single bare mutation site is a finding"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -178,6 +191,14 @@ _BARE_PRIMITIVES = frozenset(
 # (.get, .items, len) are free; anything here must sit under the
 # cache's named lock.
 _CACHE_STATE_ATTRS = frozenset(("_entries", "_flights"))
+# DML017: the global scheduler's tenancy accounting (ISSUE 18) —
+# DECLARED guarded by the scheduler's named condition, not inferred
+# like DML010 (inference needs two locked sites; a brand-new counter
+# with one bare mutation site would sail through it). Attribute names
+# chosen to be unique to serve/tenancy.py within serve/.
+_TENANCY_STATE_ATTRS = frozenset(
+    ("_tokens", "_deficits", "_skips", "_granted", "_pending_rows",
+     "_queues", "_cursor"))
 _MUTATING_METHODS = frozenset(
     ("pop", "popitem", "clear", "setdefault", "update", "move_to_end",
      "append"))
@@ -573,6 +594,32 @@ def _check_dml010(flows: list, always: dict, rel: str,
                 f"`{attr}` in this module hold it (lock-containment "
                 "inference: registry version-table / fleet pick-lock "
                 "bug class)"))
+
+
+def _check_dml017(flows: list, always: dict, rel: str,
+                  findings: list) -> None:
+    """Declared lock containment for the tenancy scheduler's state
+    (ISSUE 18): any mutation of a _TENANCY_STATE_ATTRS field in serve/
+    whose effective lock set is EMPTY is a finding — no two-site
+    inference threshold like DML010, because this state's guard is a
+    design contract (serve/tenancy.py's module docstring), not a
+    pattern to be learned. __init__/__post_init__ construction is
+    pre-publication and exempt."""
+    for f in flows:
+        if f.name.split(".")[-1] in ("__init__", "__post_init__"):
+            continue
+        base = always[f.name]
+        for attr, lineno, held, desc, _is_self in f.mutations:
+            if attr not in _TENANCY_STATE_ATTRS:
+                continue
+            if not (held | base):
+                findings.append(Finding(
+                    rel, lineno, "DML017",
+                    f"mutation `{desc}` of declared-guarded tenancy "
+                    "state outside any named lock — every admission/"
+                    "fairness field mutates only under the "
+                    "scheduler's condition (tenancy.sched), or a "
+                    "grant decision can be torn mid-flight"))
 
 
 def _check_dml011(tree: ast.AST, rel: str, findings: list) -> None:
@@ -980,6 +1027,10 @@ def _dml011_scope(rel: str) -> bool:
     return _thread_scope(rel)
 
 
+def _dml017_scope(rel: str) -> bool:
+    return _in_serve_pkg(rel)
+
+
 def _dml012_scope(rel: str) -> bool:
     # engine.py IS the staging path; quantize.py is build-time weight
     # preparation the engine device_puts as a whole.
@@ -1225,7 +1276,8 @@ def lint_source(text: str, rel: str) -> list:
 
     # DML009/DML010: the interprocedural dataflow pass (shared lock
     # vocabulary + always-held inference, computed once per module).
-    if _dml009_scope(rel) or _dml010_scope(rel):
+    if (_dml009_scope(rel) or _dml010_scope(rel)
+            or _dml017_scope(rel)):
         lock_names = _lock_attr_names(tree)
         flows = _collect_flows(tree, lock_names)
         always = _always_held(flows)
@@ -1233,6 +1285,10 @@ def lint_source(text: str, rel: str) -> list:
             _check_dml009(flows, always, rel, findings)
         if _dml010_scope(rel):
             _check_dml010(flows, always, rel, findings)
+        # DML017: declared lock containment for the tenancy
+        # scheduler's state (ISSUE 18) — same flows/always pass.
+        if _dml017_scope(rel):
+            _check_dml017(flows, always, rel, findings)
     # DML011: jit-cache-key hazards in serving/bench code.
     if _dml011_scope(rel):
         _check_dml011(tree, rel, findings)

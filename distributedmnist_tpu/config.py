@@ -231,6 +231,23 @@ class Config:
     # gate judges the override; a failing override refuses loudly).
     serve_cascade: bool = False
     serve_cascade_threshold: Optional[float] = None
+    # Multi-tenant, multi-model serving (ISSUE 18, serve/tenancy.py):
+    # serve_models lists the catalog ("mlp,lenet" boots BOTH models,
+    # each with its own registry/router/batcher and checkpoint subtree
+    # <checkpoint_dir>/<model>; empty = just cfg.model, the single-
+    # model compatibility path). serve_tenants configures the admission
+    # classes the X-Tenant header maps to —
+    # "name:qps=50,burst=25,deadline_ms=50,weight=1,model=mlp;..." —
+    # token-bucket quota (429 + Retry-After past it), per-class default
+    # deadline (infeasible heads shed 504 by the cost model), and the
+    # weighted-fair scheduling weight. Empty = tenancy layer off.
+    # serve_tenant_quantum_us is the deficit-round-robin credit each
+    # ring visit grants per unit weight, in microseconds of MODELED
+    # dispatch cost: smaller interleaves tenants more finely, larger
+    # amortizes scheduling over longer per-tenant runs.
+    serve_models: str = ""
+    serve_tenants: str = ""
+    serve_tenant_quantum_us: float = 5000.0
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -449,6 +466,26 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "below it escalate). The composed-accuracy "
                         "gate still judges the override — a failing "
                         "value refuses the cascade loudly")
+    p.add_argument("--serve-models", default=None,
+                   help="[serving] comma-separated model catalog "
+                        "(serve/tenancy.py): 'mlp,lenet' serves BOTH "
+                        "models from one process, each with its own "
+                        "registry, bucket geometry, cost tables and "
+                        "checkpoint subtree <checkpoint-dir>/<model>. "
+                        "Empty (default) serves --model alone")
+    p.add_argument("--serve-tenants", default=None,
+                   help="[serving] tenant SLO classes for the X-Tenant "
+                        "header, 'name:qps=50,burst=25,deadline_ms=50,"
+                        "weight=1,model=mlp;name2:...' — token-bucket "
+                        "quota (429 + Retry-After on breach), default "
+                        "deadline (infeasible requests shed 504), and "
+                        "weighted-fair-queueing weight. Setting this "
+                        "routes /predict through the global scheduler "
+                        "(GET /tenants, POST /tenants/{id}/quota)")
+    p.add_argument("--serve-tenant-quantum-us", type=float, default=None,
+                   help="[serving] deficit-round-robin quantum: modeled "
+                        "dispatch microseconds credited per ring visit "
+                        "per unit tenant weight")
     p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "

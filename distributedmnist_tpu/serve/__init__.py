@@ -40,6 +40,14 @@ north star's "serves heavy traffic from millions of users".
               infer_dtype, content hash), concurrent identical misses
               collapsed onto one in-flight computation, registry-
               invalidated atomically on every live-route change
+- tenancy.py  multi-tenant, multi-model serving (ISSUE 18): the
+              ModelCatalog hosting independent serving stacks per
+              model, token-bucket admission per tenant SLO class, and
+              the Clockwork-style global scheduler — weighted deficit
+              round robin across tenants, earliest-feasible-deadline
+              across each tenant's model queues, dispatch priced by
+              the measured per-bucket cost tables, infeasible heads
+              shed NOW, cold models warmed as priced scheduled events
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -115,6 +123,19 @@ _EXPORTS = {
     "content_key": ("distributedmnist_tpu.serve.cache", "content_key"),
     "build_cache_front": ("distributedmnist_tpu.serve.cache",
                           "build_cache_front"),
+    "ModelCatalog": ("distributedmnist_tpu.serve.tenancy",
+                     "ModelCatalog"),
+    "GlobalScheduler": ("distributedmnist_tpu.serve.tenancy",
+                        "GlobalScheduler"),
+    "QuotaExceeded": ("distributedmnist_tpu.serve.tenancy",
+                      "QuotaExceeded"),
+    "SLOClass": ("distributedmnist_tpu.serve.tenancy", "SLOClass"),
+    "parse_tenants": ("distributedmnist_tpu.serve.tenancy",
+                      "parse_tenants"),
+    "build_catalog": ("distributedmnist_tpu.serve.tenancy",
+                      "build_catalog"),
+    "build_tenancy": ("distributedmnist_tpu.serve.tenancy",
+                      "build_tenancy"),
 }
 
 __all__ = list(_EXPORTS)

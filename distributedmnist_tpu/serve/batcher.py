@@ -158,6 +158,12 @@ class _Request:
     # uniform: a batch runs ONE engine's program, so requests pinned to
     # different routes never coalesce together.
     route: Optional[str] = None
+    # Attribution tags (ISSUE 18): the tenancy layer stamps
+    # {"tenant": ..., "model": ...} here so the request's queue.wait
+    # span and its dispatch's batch.dispatch span carry the tenant/
+    # model identity end-to-end. None (every direct caller) keeps the
+    # pre-tenancy span shape byte-identical.
+    tags: Optional[dict] = None
 
 
 class DynamicBatcher:
@@ -280,7 +286,8 @@ class DynamicBatcher:
 
     def submit(self, x, deadline_s: Optional[float] = None,
                key: Optional[bytes] = None,
-               route: Optional[str] = None) -> Future:
+               route: Optional[str] = None,
+               tags: Optional[dict] = None) -> Future:
         """Enqueue up to max_batch rows; Future resolves to their logits.
         Raises Rejected past the queue watermark (overload shedding),
         ValueError for requests no single dispatch could ever carry,
@@ -292,7 +299,9 @@ class DynamicBatcher:
         waiting (the 504-fast path — see _take_batch). `route` pins the
         dispatch to a named infer_dtype (the cascade's stage requests);
         routed requests take the coalescing path only — the fast lane's
-        resident program is compiled for the live route."""
+        resident program is compiled for the live route. `tags` (the
+        tenancy layer's {"tenant", "model"} attribution) ride onto this
+        request's queue.wait span and its dispatch's span."""
         x = self.engine._as_images(x)
         n = x.shape[0]
         if n > self.max_batch:
@@ -314,7 +323,7 @@ class DynamicBatcher:
         req = _Request(x=x, n=n, t_enqueue=now, rid=next(self._rid),
                        deadline=deadline_s,
                        key=key if self.dedup else None,
-                       route=route)
+                       route=route, tags=tags)
         tr = trace.active()
         if tr is not None:
             # Trace opened BEFORE the queue insert so the dispatch
@@ -492,7 +501,8 @@ class DynamicBatcher:
                 f"({(t_shed - req.deadline) * 1e3:.1f} ms past); "
                 "shed before dispatch")
             trace.add_span("queue.wait", req.t_enqueue, t_shed,
-                           rids=(req.rid,), shed=True)
+                           rids=(req.rid,), shed=True,
+                           **(req.tags or {}))
             trace.add_span("deadline.shed", t_shed, t_shed,
                            rids=(req.rid,))
             self._finish_trace(req, error=err)
@@ -540,7 +550,7 @@ class DynamicBatcher:
                 shed.append((req, now))
                 continue
             trace.add_span("queue.wait", req.t_enqueue, now,
-                           rids=(req.rid,))
+                           rids=(req.rid,), **(req.tags or {}))
             taken += req.n
             batch.append(req)
         if not batch:
@@ -628,6 +638,25 @@ class DynamicBatcher:
             rids.extend(d.rid for d in r.dups)
         return rids
 
+    @staticmethod
+    def _span_tags(seg: list[_Request]) -> dict:
+        """Segment-level attribution for the batch.dispatch span
+        (ISSUE 18): the model tag is drain-uniform (one batch runs one
+        engine program) so the first tagged request speaks for all;
+        tenants can coalesce, so the span carries the sorted distinct
+        set. Untagged segments (every pre-tenancy caller) contribute
+        nothing — the span shape is unchanged."""
+        tags: dict = {}
+        tenants = sorted({r.tags["tenant"] for r in seg
+                          if r.tags and "tenant" in r.tags})
+        if tenants:
+            tags["tenants"] = ",".join(tenants)
+        for r in seg:
+            if r.tags and "model" in r.tags:
+                tags["model"] = r.tags["model"]
+                break
+        return tags
+
     def _live_version(self) -> Optional[str]:
         """The version a dispatch failure is blamed on: the engine's
         live target (Router) or its own version label (bare engine);
@@ -668,7 +697,8 @@ class DynamicBatcher:
         dispatch containing the poison request — and only those."""
         rids = [r.rid for r in seg]
         sp = trace.begin_span("batch.dispatch", rids=self._span_rids(seg),
-                              rows=sum(r.n for r in seg))
+                              rows=sum(r.n for r in seg),
+                              **self._span_tags(seg))
         try:
             # failpoint ctx carries the DISPATCHED rids only: dedup
             # riders are not in this dispatch, so a request-sticky

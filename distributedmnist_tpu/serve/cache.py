@@ -191,6 +191,31 @@ class PredictionCache:
             self._hit_rows += entry.logits.shape[0]
             return np.array(entry.logits)
 
+    def probe(self, key: tuple) -> Optional[np.ndarray]:
+        """Shed-path lookup (ISSUE 18): the tenancy layer consults the
+        cache BEFORE a quota or watermark shed — a hit costs zero
+        device work, so serving it never needed the capacity the shed
+        protects, and it must never be 429/503'd. A hit counts (and
+        refreshes LRU recency) exactly like lookup's; a MISS counts
+        nothing — the request was never going to dispatch, so a probe
+        miss says nothing about the cache's effectiveness and must not
+        dilute the hit ratio the /metrics surface reports."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            if self._expired_locked(entry, time.monotonic()):
+                del self._entries[key]
+                return None
+            if entry.version != key[0] or entry.infer_dtype != key[1]:
+                del self._entries[key]
+                self._stale_drops += 1
+                return None
+            self._entries.move_to_end(key)
+            self._hits += 1
+            self._hit_rows += entry.logits.shape[0]
+            return np.array(entry.logits)
+
     def insert(self, key: tuple, logits: np.ndarray,
                computed_version: Optional[str],
                computed_dtype: Optional[str],
@@ -310,7 +335,8 @@ class CacheFront:
 
     def submit(self, x, deadline_s: Optional[float] = None,
                route: Optional[str] = None,
-               route_label: Optional[str] = None) -> Future:
+               route_label: Optional[str] = None,
+               tags: Optional[dict] = None) -> Future:
         """Cache-or-collapse-or-dispatch. Returns a Future resolving to
         the request's (n, 10) logits:
 
@@ -329,6 +355,9 @@ class CacheFront:
         route) replaces the live dtype in the cache key, so a pinned
         stage's bytes are keyed — and only ever served — under the
         precision that computed them, never the live route's label.
+        `tags` (the tenancy layer's attribution, ISSUE 18) pass
+        through to the batcher for a leading miss — hits and collapsed
+        followers never reach a queue, so they carry none.
         """
         x = self.router._as_images(x)
         n = x.shape[0]
@@ -346,7 +375,9 @@ class CacheFront:
             # warming / drained of versions: nothing to key on; the
             # pipeline's NoLiveModel 503 path is authoritative
             return self.batcher.submit(x, deadline_s=deadline_s,
-                                       route=route)
+                                       route=route,
+                                       **({"tags": tags} if tags
+                                          else {}))
         if route_label is None:
             route_label = route
         if route_label is not None:
@@ -421,7 +452,7 @@ class CacheFront:
             return self._resolve_hit(hit, n, t0, deadline_s)
         if not leading:
             return follower.future
-        return self._lead(flight, x, deadline_s, route)
+        return self._lead(flight, x, deadline_s, route, tags=tags)
 
     def _resolve_hit(self, entry: _Entry, n: int, t0: float,
                      deadline_s: Optional[float]) -> Future:
@@ -455,13 +486,19 @@ class CacheFront:
         return fut
 
     def _lead(self, flight: _Flight, x, deadline_s,
-              route: Optional[str] = None) -> Future:
+              route: Optional[str] = None,
+              tags: Optional[dict] = None) -> Future:
         """Dispatch the leader through the batcher. The leader's OWN
         future is the batcher's (its trace, version tag and error
         semantics are untouched); the flight resolves from it."""
         try:
+            # tags only when they carry attribution: absent tenancy,
+            # the call keeps the pre-ISSUE-18 submit shape (duck-typed
+            # batcher fakes across the suite depend on it)
             bf = self.batcher.submit(x, deadline_s=deadline_s,
-                                     key=flight.key[3], route=route)
+                                     key=flight.key[3], route=route,
+                                     **({"tags": tags} if tags
+                                        else {}))
         except BaseException as e:
             # Rejected / DeadlineExceeded / stopped batcher: the flight
             # never got a computation — followers that slipped in

@@ -136,6 +136,14 @@ class ServeMetrics:
             self._cascade_escalated_requests = 0
             self._cascade_escalated_rows = 0
             self._cascade_degraded = 0
+            # tenancy accounting (ISSUE 18): per-tenant admission /
+            # shed / dispatch / SLO populations and per-model demand,
+            # recorded by serve/tenancy.py's GlobalScheduler. Keyed by
+            # the RESOLVED SLO-class name (unknown X-Tenant headers
+            # collapse into "default" at admission), so cardinality is
+            # bounded by configuration, never by client-chosen labels.
+            self._by_tenant: dict[str, dict] = {}
+            self._by_model: dict[str, dict] = {}
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -228,6 +236,73 @@ class ServeMetrics:
         to an uncascaded version). Loud, never an error."""
         with self._lock:
             self._cascade_degraded += 1
+
+    # -- tenancy hooks (ISSUE 18, called by the GlobalScheduler) -----------
+
+    def _tenant_stats(self, tenant: str) -> dict:
+        # caller holds the lock, like _version_stats
+        return self._by_tenant.setdefault(tenant, {
+            "requests": 0, "rows": 0, "dispatched_rows": 0,
+            "quota_sheds": 0, "watermark_sheds": 0, "deadline_sheds": 0,
+            "shed_rows": 0, "cache_hits": 0, "slo_hits": 0,
+            "slo_total": 0,
+            "lat": deque(maxlen=min(self._max_samples, 10_000))})
+
+    def record_tenant_request(self, tenant: str, model: str,
+                              rows: int = 1) -> None:
+        """One request ADMITTED (or cache-served) for a tenant, routed
+        to a model — the demand side of the by_tenant/by_model split."""
+        with self._lock:
+            t = self._tenant_stats(tenant)
+            t["requests"] += 1
+            t["rows"] += rows
+            m = self._by_model.setdefault(
+                model, {"requests": 0, "rows": 0, "dispatched_rows": 0})
+            m["requests"] += 1
+            m["rows"] += rows
+
+    def record_tenant_shed(self, tenant: str, kind: str,
+                           rows: int = 1) -> None:
+        """One tenant request shed at admission or grant time:
+        kind in {"quota" (429), "watermark" (503), "deadline" (504)}.
+        The global reject/deadline counters are recorded separately by
+        the scheduler — this is the per-tenant attribution."""
+        with self._lock:
+            t = self._tenant_stats(tenant)
+            t[f"{kind}_sheds"] += 1
+            t["shed_rows"] += rows
+
+    def record_tenant_dispatch(self, tenant: str, model: str,
+                               rows: int) -> None:
+        """Rows GRANTED to a tenant by one WFQ dispatch decision — the
+        service side, whose share over all tenants is the fairness
+        ratio's numerator."""
+        with self._lock:
+            self._tenant_stats(tenant)["dispatched_rows"] += rows
+            m = self._by_model.setdefault(
+                model, {"requests": 0, "rows": 0, "dispatched_rows": 0})
+            m["dispatched_rows"] += rows
+
+    def record_tenant_cache_hit(self, tenant: str,
+                                rows: int = 1) -> None:
+        """A would-be quota/watermark shed served from the prediction
+        cache instead (the cache-aware shed): zero device work, never
+        a 429/503."""
+        with self._lock:
+            self._tenant_stats(tenant)["cache_hits"] += 1
+
+    def record_tenant_done(self, tenant: str, seconds: float,
+                           slo_ok=None) -> None:
+        """One tenant request completed end-to-end (admission to
+        resolution). `slo_ok` says whether it made its deadline (None
+        = best-effort class, excluded from attainment)."""
+        with self._lock:
+            t = self._tenant_stats(tenant)
+            t["lat"].append(seconds)
+            if slo_ok is not None:
+                t["slo_total"] += 1
+                if slo_ok:
+                    t["slo_hits"] += 1
 
     def record_dedup(self, requests: int, rows: int) -> None:
         """Intra-batch dedup riders (ISSUE 10): identical rows inside
@@ -435,6 +510,10 @@ class ServeMetrics:
                 for v, s in self._by_version.items()}
             shadow_raw = {pair: dict(s)
                           for pair, s in self._shadow.items()}
+            by_tenant_raw = {
+                t: {**{k: v for k, v in s.items() if k != "lat"},
+                    "lat": list(s["lat"])}
+                for t, s in self._by_tenant.items()}
             c = {
                 "requests": self._requests,
                 "rows": self._rows,
@@ -493,9 +572,13 @@ class ServeMetrics:
                     dict(self._breaker_trips_by_version),
                 "rollbacks": self._rollbacks,
                 "last_rollback": self._last_rollback,
+                "by_model": {m: dict(s)
+                             for m, s in self._by_model.items()},
             }
         lat_ms = {k: (round(v * 1e3, 3) if v is not None else None)
                   for k, v in percentiles(lat).items()}
+        total_tenant_dispatched = sum(
+            s["dispatched_rows"] for s in by_tenant_raw.values())
         # escalated rows over cheap-stage rows: the fraction of stage-1
         # work the calibrated threshold sent on to f32 (None before any
         # cascade traffic)
@@ -576,6 +659,26 @@ class ServeMetrics:
                            sorted(c["by_replica"].items())},
             "by_dtype": {d: s for d, s in
                          sorted(c["by_dtype"].items())},
+            # the tenancy split (ISSUE 18): per-tenant demand, sheds
+            # by kind, dispatched service (whose share over all
+            # tenants is the WFQ fairness ratio's numerator), SLO
+            # attainment, and per-model demand across the catalog
+            "by_tenant": {
+                t: {**{k: v for k, v in s.items() if k != "lat"},
+                    "dispatch_share": (
+                        round(s["dispatched_rows"]
+                              / total_tenant_dispatched, 4)
+                        if total_tenant_dispatched else None),
+                    "slo_attainment": (
+                        round(s["slo_hits"] / s["slo_total"], 4)
+                        if s["slo_total"] else None),
+                    "latency_ms": {
+                        k: (round(x * 1e3, 3) if x is not None
+                            else None)
+                        for k, x in percentiles(s["lat"]).items()}}
+                for t, s in sorted(by_tenant_raw.items())},
+            "by_model": {m: s for m, s in
+                         sorted(c["by_model"].items())},
             # the front layer's served populations (ISSUE 10): the
             # cache's own hit/miss/evict counters + hit ratio live in
             # PredictionCache.stats(), surfaced as /metrics' `cache`
@@ -787,6 +890,33 @@ _PROM_HELP = {
     "dmnist_serve_cascade_degraded_total":
         "Cascade-front requests served by the plain live route "
         "(no calibrated cascade on the live version).",
+    # multi-tenant scheduler (ISSUE 18)
+    "dmnist_serve_tenant_requests_total":
+        "Requests admitted per tenant SLO class.",
+    "dmnist_serve_tenant_rows_total":
+        "Rows admitted per tenant SLO class.",
+    "dmnist_serve_tenant_dispatched_rows_total":
+        "Rows the global scheduler granted per tenant (the WFQ "
+        "service share's numerator).",
+    "dmnist_serve_tenant_sheds_total":
+        "Requests shed per tenant by kind: quota (429), watermark "
+        "(503), deadline (504 / infeasible-by-cost-model).",
+    "dmnist_serve_tenant_cache_hits_total":
+        "Would-be sheds rescued by a prediction-cache probe (the "
+        "cache-aware shed path; never quota-charged).",
+    "dmnist_serve_tenant_dispatch_share":
+        "Tenant's fraction of all scheduler-granted rows; divide by "
+        "the weight share for the WFQ fairness ratio.",
+    "dmnist_serve_tenant_slo_attainment":
+        "Fraction of a tenant's completed requests that finished "
+        "inside their SLO-class deadline.",
+    "dmnist_serve_tenant_latency_ms":
+        "Per-tenant end-to-end latency quantiles (enqueue at the "
+        "global scheduler to future resolution), milliseconds.",
+    "dmnist_serve_model_requests_total":
+        "Requests routed per catalog model.",
+    "dmnist_serve_model_dispatched_rows_total":
+        "Rows the scheduler granted per catalog model.",
 }
 
 
@@ -952,6 +1082,43 @@ def prometheus_exposition(snapshot: dict,
          [({}, ca.get("escalation_fraction"))])
     emit("dmnist_serve_cascade_degraded_total", "counter",
          [({}, ca.get("degraded_requests"))])
+    # multi-tenant scheduler (ISSUE 18): per-tenant demand/service/
+    # shed split and per-model catalog demand. Labels come from the
+    # operator-configured SLO-class names and catalog model names, so
+    # cardinality is bounded by configuration, not by traffic.
+    bt = s.get("by_tenant", {})
+    emit("dmnist_serve_tenant_requests_total", "counter",
+         [({"tenant": t}, ts.get("requests"))
+          for t, ts in bt.items()])
+    emit("dmnist_serve_tenant_rows_total", "counter",
+         [({"tenant": t}, ts.get("rows")) for t, ts in bt.items()])
+    emit("dmnist_serve_tenant_dispatched_rows_total", "counter",
+         [({"tenant": t}, ts.get("dispatched_rows"))
+          for t, ts in bt.items()])
+    emit("dmnist_serve_tenant_sheds_total", "counter",
+         [({"tenant": t, "kind": kind}, ts.get(f"{kind}_sheds"))
+          for t, ts in bt.items()
+          for kind in ("quota", "watermark", "deadline")])
+    emit("dmnist_serve_tenant_cache_hits_total", "counter",
+         [({"tenant": t}, ts.get("cache_hits"))
+          for t, ts in bt.items()])
+    emit("dmnist_serve_tenant_dispatch_share", "gauge",
+         [({"tenant": t}, ts.get("dispatch_share"))
+          for t, ts in bt.items()])
+    emit("dmnist_serve_tenant_slo_attainment", "gauge",
+         [({"tenant": t}, ts.get("slo_attainment"))
+          for t, ts in bt.items()])
+    emit("dmnist_serve_tenant_latency_ms", "summary",
+         [({"tenant": t, "quantile": q}, ts.get("latency_ms", {}).get(p))
+          for t, ts in bt.items()
+          for p, q in _PROM_QUANTILES.items()])
+    bm = s.get("by_model", {})
+    emit("dmnist_serve_model_requests_total", "counter",
+         [({"model": m}, ms.get("requests"))
+          for m, ms in bm.items()])
+    emit("dmnist_serve_model_dispatched_rows_total", "counter",
+         [({"model": m}, ms.get("dispatched_rows"))
+          for m, ms in bm.items()])
     if cache:
         emit("dmnist_serve_cache_hits_total", "counter",
              [({}, cache.get("hits"))])

@@ -173,6 +173,130 @@ def plan_segments(sizes: Sequence[int], buckets: Sequence[int],
     return [b - a for a, b in zip(cuts, cuts[1:])]
 
 
+# -- multi-tenant WFQ / EDF policy (ISSUE 18) -------------------------------
+#
+# Pure decision functions for the tenancy layer (serve/tenancy.py).
+# Like plan_segments, they own the POLICY and nothing else: the
+# GlobalScheduler calls them under its own named lock with plain dicts
+# and lists, so the accounting is deterministic and unit-testable
+# without threads, and the lint's DML017 containment check stays about
+# WHERE the tenancy state is mutated (under the scheduler lock), not
+# about what these functions compute.
+
+
+def estimate_dispatch_s(rows: int, buckets: Sequence[int],
+                        costs: Mapping[int, float],
+                        default_per_row_s: float = 1e-3) -> float:
+    """Price a prospective dispatch of `rows` real rows against a
+    model's measured bucket-cost ladder: the affine fit evaluated at
+    the covering bucket (padding included — the program runs the whole
+    bucket regardless). Clockwork's premise is that these costs are
+    known and stable, so deadline feasibility can be decided BEFORE
+    queueing delay is spent. With no complete cost table (stub engine,
+    pre-warmup, explorer fakes) falls back to a row-proportional unit
+    price so policy stays total rather than guessing zero."""
+    if rows <= 0:
+        return 0.0
+    if costs and buckets and all(b in costs for b in buckets):
+        overhead, per_row = _fitted(costs, buckets)
+        b = next((x for x in buckets if x >= rows), buckets[-1])
+        return overhead + per_row * max(b, rows)
+    return default_per_row_s * rows
+
+
+def edf_pick(heads: Sequence[tuple], now: float) -> tuple:
+    """Earliest-feasible-deadline selection across model queues.
+
+    `heads` holds one (key, deadline, est_cost_s) per non-empty queue —
+    the head-of-line request's ABSOLUTE deadline (None = best-effort)
+    and the modeled cost of dispatching it now. Returns
+    (pick, infeasible): `pick` is the key with the earliest deadline
+    among heads that can still MAKE their deadline if dispatched now
+    (best-effort heads rank after every deadlined head; ties break by
+    input order), or None when nothing is feasible. `infeasible` lists
+    the keys whose head cannot meet its deadline even with immediate
+    dispatch — Clockwork's rule is to shed those NOW (504) rather than
+    let a doomed request occupy a batch slot and poison the requests
+    behind it."""
+    infeasible = []
+    feas = []
+    for i, (key, deadline, cost_s) in enumerate(heads):
+        if deadline is not None and now + cost_s > deadline:
+            infeasible.append(key)
+        else:
+            feas.append((deadline if deadline is not None else math.inf,
+                         i, key))
+    if not feas:
+        return None, infeasible
+    feas.sort()
+    return feas[0][2], infeasible
+
+
+def drr_grant(ring: Sequence, cursor: int, deficits: dict,
+              weights: Mapping, quantum: float, head_costs: Mapping,
+              max_rounds: int = 1024) -> tuple:
+    """One weighted deficit-round-robin grant decision (pure).
+
+    `ring` is the fixed visit order of flows (tenants); `cursor` the
+    ring index of the LAST granted flow; `deficits` the per-flow credit
+    balances (mutated in place — the caller owns them and holds the
+    scheduler lock); `head_costs` maps each BACKLOGGED flow to the
+    modeled cost of its head-of-line work (absent = idle). Each visit
+    credits the flow `quantum * weight` and grants the first flow whose
+    balance covers its head — so over any interval every backlogged
+    flow's service converges to its weight share, and a flow is granted
+    within a bounded number of visits (drr_skip_bound) no matter how
+    heavy the others are: starvation-freedom by construction. Idle
+    flows' balances reset to zero (no hoarding credit while absent).
+    Returns (flow, new_cursor, rounds_scanned); (None, cursor, 0) when
+    nothing is backlogged. Raises RuntimeError after `max_rounds` full
+    scans — quantum misconfigured so badly that no head is ever
+    affordable, which callers treat as an assertion, not a wait."""
+    if not ring or not head_costs:
+        return None, cursor, 0
+    n = len(ring)
+    for f in ring:
+        if f not in head_costs:
+            deficits[f] = 0.0
+    pos = cursor % n
+    for rounds in range(max_rounds):
+        for _ in range(n):
+            pos = (pos + 1) % n
+            f = ring[pos]
+            if f not in head_costs:
+                continue
+            deficits[f] = (deficits.get(f, 0.0)
+                           + quantum * weights.get(f, 1.0))
+            if deficits[f] >= head_costs[f] - 1e-12:
+                return f, pos, rounds
+    raise RuntimeError(
+        f"drr_grant: no flow affordable after {max_rounds} full scans "
+        f"(quantum={quantum}, heads={dict(head_costs)}) — quantum is "
+        "misconfigured relative to the cost model")
+
+
+def drr_charge(deficits: dict, flow, cost: float) -> None:
+    """Debit a granted flow's balance by the work actually dispatched.
+    Clamped at zero: grant required coverage, so a negative balance can
+    only mean the dispatched run was re-priced larger than the grant —
+    carrying debt forward would punish the flow twice."""
+    deficits[flow] = max(deficits.get(flow, 0.0) - cost, 0.0)
+
+
+def drr_skip_bound(n_flows: int, max_cost: float, quantum: float,
+                   min_weight: float) -> int:
+    """Closed-form starvation bound for drr_grant: a backlogged flow is
+    granted within this many consecutive GRANTS to other flows. Each
+    full ring scan credits the flow quantum*weight, and it needs at
+    most ceil(max_cost / that) scans to afford its head; between scans
+    at most n_flows-1 other grants interleave. The tenancy layer
+    asserts its observed consecutive-skip counters stay under this —
+    the invariant the explorer machine checks on every schedule."""
+    per_scan = max(quantum * min_weight, 1e-12)
+    scans = max(int(math.ceil(max_cost / per_scan)), 1)
+    return max(n_flows, 1) * (scans + 1)
+
+
 def fastlane_eligible(enabled: bool, pending_rows: int) -> bool:
     """The bypass lane's admission rule (ISSUE 14), pure policy like
     everything in this module: a submit may skip the coalescing path

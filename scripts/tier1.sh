@@ -8,14 +8,16 @@
 # driver compares across rounds.
 #
 # Marker note: the `-m 'not slow'` selection below INCLUDES the chaos,
-# fleet, quant, analysis, trace, cache and cascade suites
+# fleet, quant, analysis, trace, cache, cascade and tenant suites
 # (tests/conftest.py registers the markers) — they are cheap and
 # deterministic by design, so the tier-1 gate covers fault injection,
 # the replica fleet, the quantized inference fast path, the
 # concurrency sanitizer/lint, the request tracer, the prediction-cache
-# front layer, and the confidence-gated cascade on every run.
+# front layer, the confidence-gated cascade, and the multi-tenant
+# scheduler (quota admission, DRR fairness, EDF shedding, the
+# two-model catalog) on every run.
 # `pytest -m quant` / `-m analysis` / `-m trace` / `-m cache` /
-# `-m cascade` select those suites alone.
+# `-m cascade` / `-m tenant` select those suites alone.
 cd "$(dirname "$0")/.." || exit 1
 # The project lint runs FIRST (ISSUE 8): a lint regression (bare
 # threading primitive, unknown failpoint name, wall-clock timing, ...)

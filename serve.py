@@ -380,13 +380,13 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 warm, retry_after_cap_s: float = 30.0,
                 infer_dtype_choice: str = "float32",
                 front=None, cache=None, cascade: bool = False,
-                cascade_threshold=None) -> dict:
+                cascade_threshold=None, scheduler=None) -> dict:
     import concurrent.futures
     import math
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from distributedmnist_tpu.serve import (DeadlineExceeded, NoLiveModel,
-                                            Rejected,
+                                            QuotaExceeded, Rejected,
                                             prometheus_exposition)
     from distributedmnist_tpu.serve import trace as trace_lib
     from distributedmnist_tpu.serve.cascade import ACCURACY_CLASSES
@@ -529,9 +529,26 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 tracer = trace_lib.active()
                 payload["trace"] = (tracer.snapshot()
                                     if tracer is not None else None)
+                # the global scheduler's live view (ISSUE 18; None on
+                # a single-model server) — same dict GET /tenants
+                # serves
+                payload["tenancy"] = (scheduler.snapshot()
+                                      if scheduler is not None else None)
                 self._send(200, payload)
             elif self.path == "/models":
                 self._send(200, registry.describe())
+            elif self.path == "/tenants":
+                # The scheduler's own view (ISSUE 18): per-tenant
+                # admission config + live DRR accounting, catalog
+                # residency. 409 without the tenancy layer — the
+                # resource genuinely does not exist on this server.
+                if scheduler is None:
+                    self._send(409, {
+                        "error": "multi-tenant serving is off; "
+                                 "--serve-tenants/--serve-models "
+                                 "enables it"})
+                else:
+                    self._send(200, scheduler.snapshot())
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -544,6 +561,8 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 self._models_promote()
             elif self.path.startswith("/replicas/"):
                 self._replicas_admin()
+            elif self.path.startswith("/tenants/"):
+                self._tenants_admin()
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -579,6 +598,56 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # e.g. draining the last active replica: a rule
                 # refusal, not a server fault
                 self._send(409, {"error": str(e)})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        # -- admin: tenant quotas (ISSUE 18) ---------------------------
+
+        def _tenants_admin(self):
+            """POST /tenants/{id}/quota {"qps": x, "burst": y} — live-
+            update one SLO class's token bucket. The bucket refills to
+            the new burst so a loosened quota takes effect NOW. 404 for
+            an unknown tenant, 400 for malformed numbers, 409 without
+            the tenancy layer."""
+            parts = self.path.strip("/").split("/")
+            if len(parts) != 3 or parts[2] != "quota":
+                self._send(404, {"error": "want POST /tenants/{id}/"
+                                          "quota"})
+                return
+            if scheduler is None:
+                self._send(409, {
+                    "error": "multi-tenant serving is off; "
+                             "--serve-tenants/--serve-models enables "
+                             "it"})
+                return
+            _, tenant, _ = parts
+            try:
+                body = self._json_body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            for k in ("qps", "burst"):
+                v = body.get(k)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or isinstance(v, bool)
+                                      or not math.isfinite(v)):
+                    self._send(400, {"error": f"{k!r} must be a finite "
+                                              f"number, got {v!r}"})
+                    return
+            try:
+                with admin_lock:
+                    cls = scheduler.set_quota(tenant,
+                                              qps=body.get("qps"),
+                                              burst=body.get("burst"))
+                self._send(200, {"tenant": tenant, "qps": cls.qps,
+                                 "burst": cls.burst,
+                                 "weight": cls.weight,
+                                 "deadline_ms": cls.deadline_ms})
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+            except ValueError as e:
+                # SLOClass validation refused the values (e.g. qps<=0)
+                self._send(400, {"error": str(e)})
             except Exception as e:
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -760,6 +829,20 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                                  "confidence cascade; restart with "
                                  "--serve-cascade"})
                     return
+            # Tenant identity (ISSUE 18): X-Tenant names the SLO class
+            # this request is admitted under — quota, deadline and
+            # weight all follow from it (unknown names fall to the
+            # "default" class INSIDE the scheduler, so accounting still
+            # sees them). Sent to a single-model server it is a client
+            # config error, loud like X-Accuracy-Class above — the
+            # client believes it has an SLO contract this server will
+            # not honor.
+            tenant_hdr = self.headers.get("X-Tenant")
+            if tenant_hdr is not None and scheduler is None:
+                self._send(400, {
+                    "error": "X-Tenant requires multi-tenant serving; "
+                             "restart with --serve-tenants"})
+                return
             raw = self.rfile.read(length)
             x = np.frombuffer(raw, np.uint8).reshape(-1, IMAGE_BYTES)
             fut = None
@@ -793,7 +876,10 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # comes back already resolved (still version-tagged and
                 # X-Trace-Id'd), a collapsed miss shares its leader's
                 # computation, everything else flows to the batcher
-                if accuracy_class is not None:
+                if scheduler is not None:
+                    fut = scheduler.submit(x, tenant=tenant_hdr,
+                                           deadline_s=deadline_s)
+                elif accuracy_class is not None:
                     fut = submit_to.submit(x, deadline_s=deadline_s,
                                            accuracy_class=accuracy_class)
                 else:
@@ -801,6 +887,15 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 logits = fut.result(timeout=(
                     request_timeout if budget_s is None
                     else min(request_timeout, budget_s)))
+            except QuotaExceeded as e:
+                # over the tenant's token bucket (ISSUE 18): 429 with
+                # the bucket's own refill time, capped like every other
+                # Retry-After this server sends
+                self._send(429, {"error": str(e)}, extra={
+                    "Retry-After": str(max(1, min(
+                        int(math.ceil(e.retry_after_s)),
+                        int(retry_after_cap_s))))})
+                return
             except Rejected:
                 self._send(503, {"error": "overloaded; retry"},
                            extra=retry_after())
@@ -950,6 +1045,8 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                **metrics.snapshot()}
     if cache is not None:
         summary["cache"] = cache.stats()
+    if scheduler is not None:
+        summary["tenancy"] = scheduler.snapshot()
     return summary
 
 
@@ -1020,6 +1117,39 @@ def main(argv=None) -> int:
             # nan fails both comparisons, so it lands here too — a
             # malformed threshold must never silently disable the gate
             p.error("--serve-cascade-threshold must be in [0, 1]")
+    if args.serve_tenants or args.serve_models:
+        # Multi-tenant mode (ISSUE 18): a malformed SLO-class spec is
+        # a usage error NOW — it must never boot a server that
+        # silently rate-limits nobody. The single-model fronts don't
+        # compose with the global scheduler (it owns every dispatch
+        # decision), so their flags are refused loudly instead of
+        # silently ignored.
+        if args.serve_tenants:
+            from distributedmnist_tpu.serve.tenancy import parse_tenants
+            try:
+                parse_tenants(args.serve_tenants)
+            except ValueError as e:
+                p.error(f"--serve-tenants: {e}")
+        if (args.serve_tenant_quantum_us is not None
+                and args.serve_tenant_quantum_us <= 0):
+            # a zero/negative quantum would fail deep in the scheduler
+            # boot with a traceback; misconfig is a usage error NOW
+            p.error("--serve-tenant-quantum-us must be > 0")
+        if args.serve_models:
+            for name in (s.strip()
+                         for s in args.serve_models.split(",")):
+                if name not in ("mlp", "lenet"):
+                    p.error(f"--serve-models: unknown model {name!r} "
+                            "(expected mlp|lenet)")
+        if args.serve_cascade:
+            p.error("--serve-cascade does not compose with multi-tenant "
+                    "serving (the global scheduler owns dispatch)")
+        if args.serve_replicas is not None and args.serve_replicas > 1:
+            p.error("--serve-replicas does not compose with multi-tenant "
+                    "serving yet")
+        if args.serve_fastlane:
+            p.error("--serve-fastlane does not compose with multi-tenant "
+                    "serving (every dispatch is a scheduler grant)")
     if args.serve_faults is not None:
         # a malformed chaos schedule is a usage error NOW — it must
         # never boot a server that silently injects nothing
@@ -1035,11 +1165,33 @@ def main(argv=None) -> int:
                                             build_serving, faults)
 
     metrics = ServeMetrics()
-    registry, router, factory = build_serving(cfg, metrics=metrics)
+    # Multi-tenant, multi-model mode (ISSUE 18): --serve-tenants /
+    # --serve-models boots the ModelCatalog + GlobalScheduler stack —
+    # one serving pipeline per catalog model, every dispatch decision
+    # owned by the weighted-fair, deadline-feasibility scheduler. The
+    # single-model path below stays byte-for-byte the compat default.
+    tenancy_on = bool(cfg.serve_tenants or cfg.serve_models)
+    catalog = scheduler = None
+    if tenancy_on:
+        from distributedmnist_tpu.serve import build_tenancy
+        catalog, scheduler = build_tenancy(cfg, metrics=metrics)
+        entry = catalog.get(catalog.default())
+        registry, router, factory = (entry.registry, entry.router,
+                                     entry.factory)
+        batcher = entry.batcher
+        log.info("multi-tenant serving ACTIVE: models %s, tenants %s "
+                 "(quantum %.1f ms); X-Tenant picks the SLO class, "
+                 "GET /tenants shows the scheduler's view",
+                 catalog.names(), sorted(scheduler.classes()),
+                 cfg.serve_tenant_quantum_us / 1e3)
+    else:
+        registry, router, factory = build_serving(cfg, metrics=metrics)
     # The resilience policy bundle (ISSUE 5): deadline shedding and
     # bisection live in the batcher; the circuit breaker auto-rolls the
     # live version back through the registry on trip.
-    resilience = build_resilience(cfg, registry=registry, metrics=metrics)
+    resilience = (build_resilience(cfg, registry=registry,
+                                   metrics=metrics)
+                  if not tenancy_on else None)
     if cfg.serve_faults:
         faults.install(faults.FaultInjector.from_spec(cfg.serve_faults,
                                                       seed=cfg.seed))
@@ -1057,52 +1209,73 @@ def main(argv=None) -> int:
                  "JSON; /predict responses carry X-Trace-Id",
                  cfg.serve_trace_capacity, cfg.serve_trace_sample,
                  cfg.serve_slo_ms)
-    batcher = DynamicBatcher(router, max_batch=cfg.serve_max_batch,
-                             max_wait_us=cfg.serve_max_wait_us,
-                             queue_depth=cfg.serve_queue_depth,
-                             max_inflight=cfg.serve_max_inflight,
-                             slo_ms=cfg.serve_slo_ms,
-                             adaptive=cfg.serve_adaptive,
-                             resilience=resilience,
-                             dedup=cfg.serve_dedup,
-                             fastlane=cfg.serve_fastlane,
-                             metrics=metrics).start()
-    if cfg.serve_fastlane:
-        log.info("single-request fast lane ACTIVE: an idle pipeline "
-                 "dispatches lone requests on the caller's thread "
-                 "(no coalesce wait); contention falls back to "
-                 "coalescing")
-    # The prediction cache + single-flight front layer (ISSUE 10):
-    # front is the submit target (== batcher when --serve-cache is
-    # off); the registry invalidates the cache atomically on every
-    # live-route change via the set_cache hook build_cache_front
-    # installs.
-    from distributedmnist_tpu.serve import build_cache_front
-    front, cache = build_cache_front(cfg, batcher, router, registry,
-                                     metrics=metrics)
-    if cache is not None:
-        log.info("prediction cache ACTIVE (capacity %d entries, "
-                 "dedup %s): hits skip the pipeline, identical "
-                 "concurrent misses collapse", cfg.serve_cache_capacity,
-                 "on" if cfg.serve_dedup else "off")
-    # The confidence-gated cascade front (ISSUE 17): wraps the submit
-    # target so per-request accuracy classes route through the cheap
-    # variant + escalation machinery. Wrapping is unconditional under
-    # --serve-cascade — until warm() calibrates and gates the cascade,
-    # the front degrades every class to the plain live route (metered
-    # as degraded, never an error).
-    if cfg.serve_cascade:
-        from distributedmnist_tpu.serve.cascade import CascadeFront
-        front = CascadeFront(front, batcher, router, registry,
-                             metrics=metrics, cache=cache)
-        log.info("confidence cascade REQUESTED: calibration + the "
-                 "composed-accuracy gate run at warmup; X-Accuracy-"
-                 "Class picks fast|balanced|exact per request")
+    if tenancy_on:
+        # Every submit flows through the scheduler; the default
+        # model's cache (if any) still backs the cache-aware shed
+        # inside admission, and per-model fronts live in the catalog.
+        front, cache = scheduler, catalog.get(catalog.default()).cache
+    else:
+        batcher = DynamicBatcher(router, max_batch=cfg.serve_max_batch,
+                                 max_wait_us=cfg.serve_max_wait_us,
+                                 queue_depth=cfg.serve_queue_depth,
+                                 max_inflight=cfg.serve_max_inflight,
+                                 slo_ms=cfg.serve_slo_ms,
+                                 adaptive=cfg.serve_adaptive,
+                                 resilience=resilience,
+                                 dedup=cfg.serve_dedup,
+                                 fastlane=cfg.serve_fastlane,
+                                 metrics=metrics).start()
+        if cfg.serve_fastlane:
+            log.info("single-request fast lane ACTIVE: an idle pipeline "
+                     "dispatches lone requests on the caller's thread "
+                     "(no coalesce wait); contention falls back to "
+                     "coalescing")
+        # The prediction cache + single-flight front layer (ISSUE 10):
+        # front is the submit target (== batcher when --serve-cache is
+        # off); the registry invalidates the cache atomically on every
+        # live-route change via the set_cache hook build_cache_front
+        # installs.
+        from distributedmnist_tpu.serve import build_cache_front
+        front, cache = build_cache_front(cfg, batcher, router, registry,
+                                         metrics=metrics)
+        if cache is not None:
+            log.info("prediction cache ACTIVE (capacity %d entries, "
+                     "dedup %s): hits skip the pipeline, identical "
+                     "concurrent misses collapse",
+                     cfg.serve_cache_capacity,
+                     "on" if cfg.serve_dedup else "off")
+        # The confidence-gated cascade front (ISSUE 17): wraps the
+        # submit target so per-request accuracy classes route through
+        # the cheap variant + escalation machinery. Wrapping is
+        # unconditional under --serve-cascade — until warm()
+        # calibrates and gates the cascade, the front degrades every
+        # class to the plain live route (metered as degraded, never an
+        # error).
+        if cfg.serve_cascade:
+            from distributedmnist_tpu.serve.cascade import CascadeFront
+            front = CascadeFront(front, batcher, router, registry,
+                                 metrics=metrics, cache=cache)
+            log.info("confidence cascade REQUESTED: calibration + the "
+                     "composed-accuracy gate run at warmup; X-Accuracy-"
+                     "Class picks fast|balanced|exact per request")
     log.info("dispatch pipeline depth: %d; buckets %s",
              batcher.max_inflight, list(factory.buckets))
     state = ServerState()
 
     def warm():
+        if tenancy_on:
+            # Eager residency for every catalog model: the scheduler
+            # can warm lazily (a priced event on first backlog), but a
+            # server boot warms the whole catalog so /healthz's 200
+            # means EVERY advertised model answers with zero
+            # steady-state recompiles.
+            t0 = time.perf_counter()
+            for name in catalog.names():
+                catalog.ensure_live(name, seed=cfg.seed,
+                                    infer_dtype=cfg.serve_infer_dtype)
+            log.info("catalog warmed in %.2fs: %s",
+                     time.perf_counter() - t0, catalog.describe())
+            return
         t0 = time.perf_counter()
         mv = registry.bootstrap(seed=cfg.seed)
         log.info("bootstrap %s (%s) warmed in %.2fs — %d compile "
@@ -1164,9 +1337,13 @@ def main(argv=None) -> int:
                                   front=front, cache=cache,
                                   cascade=cfg.serve_cascade,
                                   cascade_threshold=(
-                                      cfg.serve_cascade_threshold))
+                                      cfg.serve_cascade_threshold),
+                                  scheduler=scheduler)
     finally:
-        batcher.stop()
+        if scheduler is not None:
+            scheduler.stop()    # drains every per-model batcher too
+        else:
+            batcher.stop()
     # Sanitizer verdict AFTER stop() (DMNIST_SANITIZE=1 runs): a
     # mid-drain dispatch cycle legitimately holds a window slot while
     # its batch is popped-but-unresolved — "slots net zero" is only a

@@ -88,6 +88,16 @@ def pytest_configure(config):
         "(scripts/tier1.sh notes the inclusion)")
     config.addinivalue_line(
         "markers",
+        "tenant: multi-tenant / multi-model serving test "
+        "(serve/tenancy.py: tenant spec parsing, token-bucket quotas, "
+        "the deficit-round-robin grant loop, Clockwork-style EDF "
+        "feasibility shedding, the ModelCatalog and the "
+        "zero-steady-state-recompile guarantee); cheap and "
+        "deterministic, runs in tier-1 under the serve sanitizer "
+        "fixture — `-m tenant` selects just this suite "
+        "(scripts/tier1.sh notes the inclusion)")
+    config.addinivalue_line(
+        "markers",
         "trace: request-tracing test (serve/trace.py: span trees, "
         "sampling/exemplar retention, Chrome export, stage "
         "attribution, the /trace + Prometheus surfaces); cheap and "

@@ -744,6 +744,72 @@ def test_dml015_registry_parity_gate_is_allowlisted():
                if f.rule == "DML015")
 
 
+# -- DML017: declared tenancy-state containment (ISSUE 18) -----------------
+
+
+def test_dml017_single_bare_mutation_flagged():
+    """Unlike DML010's inference (which needs >= 2 locked sites to
+    learn a guard), the tenancy fields are DECLARED guarded: one
+    lock-free mutation site is a finding even with no locked sibling
+    anywhere in the module."""
+    src = ("class S:\n"
+           "    def spend(self, t):\n"
+           "        self._tokens[t][0] -= 1.0\n")
+    assert _rules(src) == ["DML017"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert f.line == 3 and "tenancy.sched" in f.message
+
+
+def test_dml017_condition_guard_and_helper_propagation_clean():
+    """Mutations under the named condition are clean, including inside
+    a helper whose every call site holds it (the _grant_locked
+    shape)."""
+    src = ("from distributedmnist_tpu.analysis.locks import "
+           "make_condition\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._cond = make_condition('tenancy.sched')\n"
+           "        self._deficits = {}\n"
+           "        self._cursor = 0\n"
+           "    def grant(self, t):\n"
+           "        with self._cond:\n"
+           "            self._charge(t)\n"
+           "            self._cursor += 1\n"
+           "    def _charge(self, t):\n"
+           "        self._deficits[t] = 0.0\n")
+    assert _rules(src) == []
+
+
+def test_dml017_init_exempt_and_serve_scope_only():
+    """Constructor initialization is pre-publication; the rule applies
+    in serve/ only (analysis/harnesses.py legitimately drives shadow
+    state with the same attribute names)."""
+    init_only = ("class S:\n"
+                 "    def __init__(self):\n"
+                 "        self._queues = {}\n"
+                 "        self._granted = {}\n")
+    assert _rules(init_only) == []
+    bare = ("class S:\n"
+            "    def f(self, t):\n"
+            "        self._queues[t] = []\n")
+    assert "DML017" in _rules(bare)
+    for rel in ("distributedmnist_tpu/analysis/harnesses.py",
+                "tests/test_serve_tenancy.py", "serve.py"):
+        assert _rules(bare, rel) == [], rel
+
+
+def test_dml017_every_declared_attr_covered():
+    """The declared set matches the scheduler's documented contract —
+    a mutation of ANY of the seven fields trips the rule."""
+    for attr in sorted(lint._TENANCY_STATE_ATTRS):
+        if attr == "_cursor":
+            src = f"class S:\n    def f(self):\n        self.{attr} = 1\n"
+        else:
+            src = (f"class S:\n    def f(self, k):\n"
+                   f"        self.{attr}[k] = 1\n")
+        assert _rules(src) == ["DML017"], attr
+
+
 # -- allowlist pragma ------------------------------------------------------
 
 

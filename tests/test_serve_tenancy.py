@@ -1,0 +1,490 @@
+"""Multi-tenant, multi-model serving (ISSUE 18): the tenant spec
+parser, the pure token-bucket/EDF/DRR policy pieces (with the
+ISSUE-required deterministic deficit-accounting walk), the
+GlobalScheduler's admission front door (429 quota + Retry-After, 503
+watermark, 504 at-the-door deadline, the cache-aware shed), the admin
+surface, WFQ fairness over stub models, queue.wait tenant attribution
+in traces, and the two-model CPU catalog's zero-steady-state-recompile
+contract with real engines."""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import (DynamicBatcher, Rejected,
+                                        ServeMetrics,
+                                        prometheus_exposition)
+from distributedmnist_tpu.serve import scheduler as policy
+from distributedmnist_tpu.serve import trace as trace_lib
+from distributedmnist_tpu.serve.resilience import DeadlineExceeded
+from distributedmnist_tpu.serve.tenancy import (CatalogEntry,
+                                                GlobalScheduler,
+                                                ModelCatalog,
+                                                QuotaExceeded, SLOClass,
+                                                parse_tenants,
+                                                token_admit)
+from tests.test_serve_batcher import StubEngine, _rows
+
+pytestmark = pytest.mark.tenant
+
+
+# -- tenant spec parsing ---------------------------------------------------
+
+
+def test_parse_tenants_full_spec():
+    classes = parse_tenants(
+        "gold:qps=100,burst=8,deadline_ms=50,weight=4,model=lenet;"
+        "free:weight=1")
+    assert set(classes) == {"gold", "free", "default"}
+    g = classes["gold"]
+    assert (g.qps, g.burst, g.deadline_ms, g.weight, g.model) == \
+        (100.0, 8.0, 50.0, 4.0, "lenet")
+    # the synthesized default class: unlimited, best-effort, weight 1
+    d = classes["default"]
+    assert d.qps is None and d.deadline_ms is None and d.weight == 1.0
+
+
+def test_parse_tenants_default_overridable_and_empty_spec():
+    classes = parse_tenants("default:qps=5,weight=2")
+    assert classes["default"].qps == 5.0
+    assert parse_tenants("")["default"].qps is None
+
+
+@pytest.mark.parametrize("spec", [
+    ":qps=1",                       # empty name
+    "a:nope=1",                     # unknown key
+    "a:qps",                        # not k=v
+    "a:qps=1;a:qps=2",              # duplicate tenant
+    "a:qps=0",                      # SLOClass validation: qps > 0
+    "a:burst=0.5",                  # burst >= 1
+    "a:deadline_ms=0",              # deadline > 0
+    "a:weight=0",                   # weight > 0
+])
+def test_parse_tenants_rejects_malformed(spec):
+    with pytest.raises(ValueError):
+        parse_tenants(spec)
+
+
+# -- pure admission / scheduling policy ------------------------------------
+
+
+def test_token_bucket_admission_math():
+    # no rate -> inert: always admitted, nothing charged
+    assert token_admit(0.0, 0.0, 100.0, None, 1.0) == (True, 0.0, 0.0)
+    # refill at qps, capped at burst, one token per admission
+    ok, tokens, retry = token_admit(0.0, 0.0, 10.0, 2.0, 4.0)
+    assert ok and tokens == 3.0 and retry == 0.0    # capped at burst=4
+    # an empty bucket refuses and quotes the EXACT refill time
+    ok, tokens, retry = token_admit(0.25, 0.0, 0.0, 2.0, 4.0)
+    assert not ok and tokens == 0.25
+    assert retry == pytest.approx((1.0 - 0.25) / 2.0)
+
+
+def test_drr_deterministic_deficit_accounting():
+    """The ISSUE-required deterministic walk: fixed ring, weights 2:1,
+    quantum 1s, equal 3s head costs — grant order, per-visit credit,
+    post-charge balances and the rounds counter are all exact."""
+    ring = ["a", "b"]
+    weights = {"a": 2.0, "b": 1.0}
+    deficits = {"a": 0.0, "b": 0.0}
+    heads = {"a": 3.0, "b": 3.0}
+    # cursor=0 (= "a" granted last), so the scan starts at "b":
+    # round 0 credits b->1, a->2 (neither affords 3); round 1 credits
+    # b->2, then a->4 >= 3: grant "a" after 1 full extra round
+    flow, cursor, rounds = policy.drr_grant(ring, 0, deficits, weights,
+                                            1.0, heads)
+    assert (flow, cursor, rounds) == ("a", 0, 1)
+    assert deficits == {"a": 4.0, "b": 2.0}
+    policy.drr_charge(deficits, "a", 3.0)
+    assert deficits == {"a": 1.0, "b": 2.0}
+    # next scan starts at "b", whose banked 2 + 1 credit covers it
+    flow, cursor, rounds = policy.drr_grant(ring, cursor, deficits,
+                                            weights, 1.0, heads)
+    assert (flow, cursor, rounds) == ("b", 1, 0)
+    policy.drr_charge(deficits, "b", 3.0)
+    assert deficits == {"a": 1.0, "b": 0.0}
+    # and "a" again: 1 banked + 2 credit = 3 covers its head
+    flow, cursor, rounds = policy.drr_grant(ring, cursor, deficits,
+                                            weights, 1.0, heads)
+    assert (flow, cursor, rounds) == ("a", 0, 0)
+    # an idle flow's balance resets (no hoarding while absent)
+    deficits["b"] = 7.5
+    policy.drr_grant(ring, 0, deficits, weights, 1.0, {"a": 1.0})
+    assert deficits["b"] == 0.0
+    # charge clamps at zero (a re-priced run must not double-punish)
+    policy.drr_charge(deficits, "a", 1e9)
+    assert deficits["a"] == 0.0
+
+
+def test_drr_converges_to_weight_share_and_respects_skip_bound():
+    ring = ["heavy", "light"]
+    weights = {"heavy": 1.0, "light": 2.0}
+    deficits = {"heavy": 0.0, "light": 0.0}
+    heads = {"heavy": 3.0, "light": 3.0}     # both always backlogged
+    bound = policy.drr_skip_bound(2, 3.0, 1.0, 1.0)
+    assert bound == 2 * (3 + 1)
+    grants = {"heavy": 0, "light": 0}
+    skips = {"heavy": 0, "light": 0}
+    cursor = 0
+    for _ in range(90):
+        flow, cursor, _ = policy.drr_grant(ring, cursor, deficits,
+                                           weights, 1.0, heads)
+        policy.drr_charge(deficits, flow, heads[flow])
+        grants[flow] += 1
+        skips[flow] = 0
+        other = "light" if flow == "heavy" else "heavy"
+        skips[other] += 1
+        assert skips[other] <= bound
+    # equal costs: the grant ratio IS the weight ratio
+    assert grants["light"] / grants["heavy"] == pytest.approx(2.0,
+                                                              rel=0.1)
+
+
+def test_edf_pick_orders_and_sheds():
+    now = 10.0
+    # earliest FEASIBLE deadline wins; best-effort ranks last
+    pick, infeasible = policy.edf_pick(
+        [("be", None, 0.01), ("late", now + 5.0, 0.01),
+         ("soon", now + 1.0, 0.01)], now)
+    assert pick == "soon" and infeasible == []
+    # a head that cannot make its deadline even now is shed, not picked
+    pick, infeasible = policy.edf_pick(
+        [("doomed", now + 0.005, 0.02), ("ok", now + 5.0, 0.01)], now)
+    assert pick == "ok" and infeasible == ["doomed"]
+    # nothing feasible and nothing best-effort: (None, all of them)
+    pick, infeasible = policy.edf_pick([("x", now + 0.001, 1.0)], now)
+    assert pick is None and infeasible == ["x"]
+    # best-effort is always feasible — it absorbs an all-doomed ring
+    pick, _ = policy.edf_pick([("x", now + 0.001, 1.0),
+                               ("be", None, 1.0)], now)
+    assert pick == "be"
+
+
+# -- GlobalScheduler over stub models --------------------------------------
+
+
+class _FakeRouter:
+    """Router-shaped double for CatalogEntry: statically live, no cost
+    table (the scheduler prices by the 1 ms/row default)."""
+
+    def __init__(self):
+        self._as_images = StubEngine._as_images
+
+    def live_version(self):
+        return "v1"
+
+    def live_infer_dtype(self):
+        return "float32"
+
+    def bucket_costs(self):
+        return {}
+
+
+def _stub_entry(name, cache=None, max_wait_us=200):
+    eng = StubEngine(max_batch=16)
+    batcher = DynamicBatcher(eng, max_wait_us=max_wait_us,
+                             queue_depth=4096).start()
+    return CatalogEntry(
+        name=name, registry=None, router=_FakeRouter(),
+        factory=types.SimpleNamespace(buckets=eng.buckets,
+                                      max_batch=eng.max_batch),
+        batcher=batcher, cache=cache)
+
+
+def _stub_sched(spec, entries=("mlp",), caches=None, start=True,
+                metrics=None, **kw):
+    catalog = ModelCatalog()
+    for name in entries:
+        catalog.add(_stub_entry(name,
+                                cache=(caches or {}).get(name)))
+    sched = GlobalScheduler(catalog, parse_tenants(spec),
+                            metrics=metrics, quantum_s=0.005, **kw)
+    return sched.start() if start else sched
+
+
+def test_quota_shed_raises_429_with_retry_after(rng):
+    metrics = ServeMetrics()
+    sched = _stub_sched("gold:qps=10,burst=1", metrics=metrics)
+    try:
+        fut = sched.submit(_rows(rng, 2), tenant="gold")
+        assert fut.result(timeout=10).shape == (2, 10)
+        with pytest.raises(QuotaExceeded) as ei:
+            sched.submit(_rows(rng, 2), tenant="gold")
+        # the bucket quotes WHEN a token exists, not just "go away"
+        assert 0.0 < ei.value.retry_after_s <= 0.1
+        assert ei.value.status == 429
+    finally:
+        sched.stop()
+    bt = metrics.snapshot()["by_tenant"]["gold"]
+    assert bt["quota_sheds"] == 1 and bt["requests"] == 1
+
+
+def test_unknown_tenant_collapses_into_default(rng):
+    sched = _stub_sched("gold:qps=100")
+    try:
+        fut = sched.submit(_rows(rng, 1), tenant="nobody-configured")
+        assert fut.result(timeout=10).shape == (1, 10)
+        snap = sched.snapshot()
+        assert snap["tenants"]["default"]["granted_rows"] == 1
+        assert "nobody-configured" not in snap["tenants"]
+    finally:
+        sched.stop()
+
+
+def test_watermark_shed_raises_503(rng):
+    metrics = ServeMetrics()
+    # not started: submits park in the tenant queue so the watermark
+    # is hit deterministically, without racing the grant loop
+    sched = _stub_sched("default:qps=1000,burst=64", start=False,
+                        metrics=metrics, tenant_queue_rows=4)
+    try:
+        sched.submit(_rows(rng, 3))
+        with pytest.raises(Rejected, match="watermark"):
+            sched.submit(_rows(rng, 3))
+    finally:
+        sched.stop(drain=False)
+    assert metrics.snapshot()["by_tenant"]["default"][
+        "watermark_sheds"] == 1
+
+
+def test_expired_deadline_shed_504_at_the_door(rng):
+    metrics = ServeMetrics()
+    sched = _stub_sched("default:", start=False, metrics=metrics)
+    try:
+        with pytest.raises(DeadlineExceeded, match="expired"):
+            sched.submit(_rows(rng, 2),
+                         deadline_s=time.monotonic() - 0.01)
+    finally:
+        sched.stop(drain=False)
+    assert metrics.snapshot()["by_tenant"]["default"][
+        "deadline_sheds"] == 1
+
+
+def test_cache_aware_shed_serves_hit_instead_of_429(rng):
+    """The ISSUE 18 satellite: an over-quota request whose answer is
+    already cached is SERVED (zero device work), never 429'd — and the
+    probe of an over-quota miss counts no cache miss."""
+    from distributedmnist_tpu.serve.cache import (PredictionCache,
+                                                  content_key)
+
+    cache = PredictionCache(capacity=16)
+    metrics = ServeMetrics()
+    sched = _stub_sched("gold:qps=10,burst=1", caches={"mlp": cache},
+                        metrics=metrics)
+    x = _rows(rng, 2)
+    logits = np.arange(20.0).reshape(2, 10)
+    cache.insert(content_key("v1", "float32",
+                             StubEngine._as_images(x)),
+                 logits, "v1", "float32")
+    try:
+        # burn the single token
+        sched.submit(_rows(rng, 1), tenant="gold").result(timeout=10)
+        misses_before = cache.stats()["misses"]
+        # over quota + cached -> served from the probe, no exception
+        fut = sched.submit(x, tenant="gold")
+        np.testing.assert_array_equal(fut.result(timeout=1), logits)
+        # over quota + NOT cached -> still a 429, and the probe's miss
+        # was not counted against the cache's hit ratio
+        with pytest.raises(QuotaExceeded):
+            sched.submit(_rows(rng, 2), tenant="gold")
+        assert cache.stats()["misses"] == misses_before
+    finally:
+        sched.stop()
+    bt = metrics.snapshot()["by_tenant"]["gold"]
+    assert bt["cache_hits"] == 1 and bt["quota_sheds"] == 1
+
+
+def test_admin_set_quota_live_and_snapshot_shape(rng):
+    sched = _stub_sched("gold:qps=10,burst=1;free:weight=2")
+    try:
+        sched.submit(_rows(rng, 1), tenant="gold").result(timeout=10)
+        with pytest.raises(QuotaExceeded):
+            sched.submit(_rows(rng, 1), tenant="gold")
+        # loosen live: the bucket refills to the NEW burst immediately
+        cls = sched.set_quota("gold", qps=1000.0, burst=8.0)
+        assert (cls.qps, cls.burst) == (1000.0, 8.0)
+        for _ in range(4):
+            sched.submit(_rows(rng, 1), tenant="gold")
+        with pytest.raises(KeyError):
+            sched.set_quota("nobody", qps=1.0)
+        snap = sched.snapshot()
+        assert set(snap["tenants"]) == {"gold", "free", "default"}
+        for t in snap["tenants"].values():
+            for k in ("qps", "burst", "weight", "queued_rows",
+                      "granted_rows", "deficit_s",
+                      "consecutive_skips"):
+                assert k in t
+        assert snap["models"]["mlp"]["resident"] is True
+        assert snap["max_skip_observed"] >= 0
+    finally:
+        sched.stop()
+
+
+def test_wfq_grant_shares_track_weights(rng):
+    """Two always-backlogged tenants at weights 2:1 over stub models:
+    granted-row shares land near the weight shares and the observed
+    consecutive-skip maximum respects the closed-form bound."""
+    metrics = ServeMetrics()
+    sched = _stub_sched(
+        "light:qps=10000,burst=256,weight=2,model=mlp;"
+        "heavy:qps=10000,burst=256,weight=1,model=lenet",
+        entries=("mlp", "lenet"), metrics=metrics)
+    try:
+        futs = []
+        for _ in range(30):
+            futs.append(sched.submit(_rows(rng, 2), tenant="light"))
+            futs.append(sched.submit(_rows(rng, 2), tenant="heavy"))
+        for f in futs:
+            assert f.result(timeout=30).shape == (2, 10)
+    finally:
+        sched.stop()
+    snap = sched.snapshot()
+    light, heavy = snap["tenants"]["light"], snap["tenants"]["heavy"]
+    assert light["granted_rows"] == heavy["granted_rows"] == 60
+    bound = policy.drr_skip_bound(
+        3, 0.016, sched.quantum_s,
+        min(c.weight for c in sched.classes().values()))
+    assert snap["max_skip_observed"] <= bound
+    # the fairness ratio's numerator lands in the metrics too
+    bt = metrics.snapshot()["by_tenant"]
+    assert bt["light"]["dispatched_rows"] == 60
+    assert bt["light"]["dispatch_share"] == pytest.approx(0.5)
+
+
+def test_queue_wait_span_carries_tenant_tag(rng):
+    """The scheduler stamps {tenant, model} on every forwarded request;
+    the batcher's queue.wait span (and the dispatch span) surface them
+    so a trace answers WHO waited, not just how long."""
+    trace_lib.uninstall()
+    tracer = trace_lib.install(trace_lib.Tracer(capacity=16,
+                                                sample=1.0))
+    sched = _stub_sched("gold:qps=100,burst=8")
+    try:
+        sched.submit(_rows(rng, 2), tenant="gold").result(timeout=10)
+    finally:
+        sched.stop()
+        trace_lib.uninstall()
+    spans = [s for t in tracer.traces() for s in t["spans"]]
+    waits = [s for s in spans if s["name"] == "queue.wait"]
+    assert waits and all(
+        s["tags"].get("tenant") == "gold" and
+        s["tags"].get("model") == "mlp" for s in waits)
+
+
+def test_prometheus_tenant_and_model_series(rng):
+    metrics = ServeMetrics()
+    sched = _stub_sched("gold:qps=10,burst=1,deadline_ms=5000",
+                        metrics=metrics)
+    try:
+        sched.submit(_rows(rng, 2), tenant="gold").result(timeout=10)
+        with pytest.raises(QuotaExceeded):
+            sched.submit(_rows(rng, 1), tenant="gold")
+    finally:
+        sched.stop()
+    text = prometheus_exposition(metrics.snapshot())
+    assert 'dmnist_serve_tenant_requests_total{tenant="gold"} 1' in text
+    assert ('dmnist_serve_tenant_sheds_total{kind="quota",'
+            'tenant="gold"} 1') in text
+    assert 'dmnist_serve_model_requests_total{model="mlp"} 1' in text
+    assert ('dmnist_serve_tenant_latency_ms{quantile="0.99",'
+            'tenant="gold"}') in text
+
+
+def test_scheduler_refuses_bad_boot():
+    catalog = ModelCatalog()
+    catalog.add(_stub_entry("mlp"))
+    try:
+        with pytest.raises(KeyError):     # class routed to a model the
+            GlobalScheduler(              # catalog does not hold
+                catalog, parse_tenants("a:model=nope"))
+        with pytest.raises(ValueError, match="quantum"):
+            GlobalScheduler(catalog, parse_tenants(""), quantum_s=0.0)
+    finally:
+        catalog.stop(drain=False)
+
+
+def test_submit_after_stop_refused(rng):
+    sched = _stub_sched("default:")
+    sched.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        sched.submit(_rows(rng, 1))
+
+
+# -- the two-model catalog with real engines -------------------------------
+
+
+def test_two_model_catalog_zero_steady_state_recompiles(rng):
+    """The ISSUE 18 acceptance contract: MLP and LeNet resident in ONE
+    process, tenant traffic interleaved across both through the global
+    scheduler, and — after each model's own warmup — exactly zero
+    compile events while serving. Per-tenant and per-model accounting
+    land in the metrics and the admin snapshot."""
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.serve.tenancy import build_tenancy
+    from distributedmnist_tpu.utils import CompileCounter
+
+    cfg = Config(device="cpu", num_devices=8, synthetic=True,
+                 model="mlp", serve_models="mlp,lenet",
+                 serve_tenants=("light:qps=10000,burst=256,weight=2,"
+                                "model=mlp;"
+                                "heavy:qps=10000,burst=256,weight=1,"
+                                "model=lenet"),
+                 serve_max_batch=16, serve_max_wait_us=500,
+                 log_every=0)
+    metrics = ServeMetrics()
+    catalog, sched = build_tenancy(cfg, metrics=metrics)
+    try:
+        for name in catalog.names():       # eager residency, as serve.py
+            catalog.ensure_live(name, seed=cfg.seed)
+        assert catalog.names() == ["mlp", "lenet"]
+        assert all(e.resident() for e in catalog.entries())
+        before = CompileCounter.instance().snapshot()
+        futs = []
+        for n in (1, 3, 7, 8, 12, 16, 5, 2) * 2:
+            futs.append((n, sched.submit(_rows(rng, n),
+                                         tenant="light")))
+            futs.append((n, sched.submit(_rows(rng, n),
+                                         tenant="heavy")))
+        for n, f in futs:
+            assert f.result(timeout=120).shape == (n, 10)
+    finally:
+        sched.stop()
+    assert CompileCounter.instance().snapshot() - before == 0, (
+        "steady-state tenant traffic recompiled — a bucket escaped "
+        "the per-model warmup")
+    snap = sched.snapshot()
+    rows = sum(n for n, _ in futs) // 2
+    assert snap["tenants"]["light"]["granted_rows"] == rows
+    assert snap["tenants"]["heavy"]["granted_rows"] == rows
+    assert snap["models"]["mlp"]["live_version"] == "v1"
+    assert snap["models"]["lenet"]["live_version"] == "v1"
+    s = metrics.snapshot()
+    assert s["by_model"]["mlp"]["dispatched_rows"] == rows
+    assert s["by_model"]["lenet"]["dispatched_rows"] == rows
+    assert s["by_tenant"]["light"]["dispatch_share"] == \
+        pytest.approx(0.5)
+
+
+def test_scheduled_warm_path_boots_cold_model(rng):
+    """A submit routed at a COLD model does not fail: the scheduler
+    prices the warmup, schedules it on the warm thread, and dispatches
+    once the model is live — best-effort heads just wait."""
+    from distributedmnist_tpu.config import Config
+    from distributedmnist_tpu.serve.tenancy import build_tenancy
+
+    cfg = Config(device="cpu", num_devices=8, synthetic=True,
+                 model="mlp", serve_models="mlp",
+                 serve_tenants="", serve_max_batch=16,
+                 serve_max_wait_us=500, log_every=0)
+    catalog, sched = build_tenancy(cfg)
+    try:
+        assert not catalog.get("mlp").resident()
+        fut = sched.submit(_rows(rng, 4))        # cold-model submit
+        assert fut.result(timeout=120).shape == (4, 10)
+        assert catalog.get("mlp").resident()
+        assert sched.snapshot()["warming"] == []
+    finally:
+        sched.stop()
