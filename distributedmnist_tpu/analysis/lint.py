@@ -174,6 +174,20 @@ RULES = {
         "their contents. DML010's inference needs >= 2 locked sites "
         "to learn a guard; these fields are DECLARED guarded (ISSUE "
         "18), so even a single bare mutation site is a finding"),
+    "DML018": (
+        "cluster epoch mutated outside the promote fan-out path",
+        "the cluster epoch is the fleet-wide serialization token for "
+        "version visibility (ISSUE 19): the gateway bumps it only "
+        "inside the two-phase promote flip (pause, drain, promote-"
+        "all, fan out), and a worker adopts it only through the "
+        "/cluster/epoch receiving end. Any other assignment — a "
+        "handler 'fixing' a stale stamp, a test helper poking the "
+        "field, a second admin path — moves the epoch without the "
+        "barrier and re-opens exactly the mixed-version window the "
+        "gateway exists to close (a reply stamped ahead of or behind "
+        "its admission epoch). Allowed writers: __init__/"
+        "__post_init__ construction, Gateway.promote_fanout, and the "
+        "worker-side apply_cluster_epoch"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -622,6 +636,57 @@ def _check_dml017(flows: list, always: dict, rel: str,
                     "grant decision can be torn mid-flight"))
 
 
+# DML018: the only function names allowed to assign `*._cluster_epoch`
+# (ISSUE 19). Construction is pre-publication; promote_fanout is the
+# gateway's two-phase flip; apply_cluster_epoch is the worker-side
+# /cluster/epoch receiving end. Everything else is a second epoch
+# writer outside the barrier.
+_CLUSTER_EPOCH_WRITERS = frozenset(
+    ("__init__", "__post_init__", "promote_fanout",
+     "apply_cluster_epoch"))
+
+
+def _check_dml018(tree: ast.AST, rel: str, findings: list) -> None:
+    """The cluster epoch mutates ONLY through the promote fan-out path
+    (ISSUE 19): any assignment to a `_cluster_epoch` attribute whose
+    enclosing function is not an allowed writer — or that sits at
+    module level — is a finding. A simple enclosing-name check, not a
+    dataflow pass: the contract is about WHICH code path may move the
+    epoch, not about which lock it holds while doing so (DML010/017
+    cover locking)."""
+
+    def visit(node: ast.AST, func: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                visit(child, child.name)
+                continue
+            if isinstance(child, (ast.Assign, ast.AugAssign,
+                                  ast.AnnAssign)):
+                targets = (child.targets
+                           if isinstance(child, ast.Assign)
+                           else [child.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and t.attr == "_cluster_epoch"
+                            and func not in _CLUSTER_EPOCH_WRITERS):
+                        where = (f"function {func!r}" if func
+                                 else "module level")
+                        findings.append(Finding(
+                            rel, child.lineno, "DML018",
+                            "cluster epoch assigned at "
+                            f"{where} — the epoch moves only "
+                            "through the promote fan-out "
+                            "(Gateway.promote_fanout) or the worker "
+                            "receiving end (apply_cluster_epoch); "
+                            "any other writer bypasses the two-phase "
+                            "barrier and re-opens the mixed-version "
+                            "window"))
+            visit(child, func)
+
+    visit(tree, "")
+
+
 def _check_dml011(tree: ast.AST, rel: str, findings: list) -> None:
     defs = {n.name: n for n in ast.walk(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
@@ -1031,6 +1096,10 @@ def _dml017_scope(rel: str) -> bool:
     return _in_serve_pkg(rel)
 
 
+def _dml018_scope(rel: str) -> bool:
+    return _in_serve_pkg(rel) or rel == "serve.py"
+
+
 def _dml012_scope(rel: str) -> bool:
     # engine.py IS the staging path; quantize.py is build-time weight
     # preparation the engine device_puts as a whole.
@@ -1306,6 +1375,10 @@ def lint_source(text: str, rel: str) -> list:
     # threshold (ISSUE 17).
     if _dml016_scope(rel):
         _check_dml016(tree, rel, findings)
+    # DML018: cluster-epoch writes outside the promote fan-out path
+    # (ISSUE 19).
+    if _dml018_scope(rel):
+        _check_dml018(tree, rel, findings)
     return findings
 
 

@@ -248,6 +248,19 @@ class Config:
     serve_models: str = ""
     serve_tenants: str = ""
     serve_tenant_quantum_us: float = 5000.0
+    # Horizontal scale-out gateway (ISSUE 19, serve/gateway.py):
+    # gateway_workers > 0 turns `serve.py --port P` into a front-door
+    # process that spawns that many full serve.py workers and routes
+    # /predict across them on a consistent-hash ring keyed like the
+    # prediction cache (hot keys shard across workers instead of
+    # duplicating). gateway_worker_inflight is the per-worker
+    # in-flight window the gateway will queue before shedding 503
+    # (backpressure that composes UNDER tenant admission);
+    # gateway_vnodes is the ring's virtual-node count per worker
+    # (more = smoother key spread, marginally slower membership ops).
+    gateway_workers: int = 0
+    gateway_worker_inflight: int = 8
+    gateway_vnodes: int = 64
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -486,6 +499,24 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="[serving] deficit-round-robin quantum: modeled "
                         "dispatch microseconds credited per ring visit "
                         "per unit tenant weight")
+    p.add_argument("--gateway", dest="gateway_workers", type=int,
+                   default=None, metavar="N",
+                   help="[serving] horizontal scale-out (serve/"
+                        "gateway.py): become a front-door process over "
+                        "N spawned serve.py workers — /predict routes "
+                        "on a consistent-hash ring keyed like the "
+                        "prediction cache (hot keys shard, not "
+                        "duplicate), promote fans out two-phase under "
+                        "a cluster epoch. Every other serving flag "
+                        "forwards to the workers verbatim")
+    p.add_argument("--gateway-worker-inflight", type=int, default=None,
+                   help="[serving] per-worker in-flight window at the "
+                        "gateway; a full window sheds 503 instead of "
+                        "spilling an affinity key to a sibling cache")
+    p.add_argument("--gateway-vnodes", type=int, default=None,
+                   help="[serving] virtual nodes per worker on the "
+                        "consistent-hash ring (more = smoother key "
+                        "spread)")
     p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "

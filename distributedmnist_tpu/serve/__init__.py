@@ -48,6 +48,14 @@ north star's "serves heavy traffic from millions of users".
               across each tenant's model queues, dispatch priced by
               the measured per-bucket cost tables, infeasible heads
               shed NOW, cold models warmed as priced scheduled events
+- gateway.py  horizontal scale-out front door (ISSUE 19): routes HTTP
+              across N spawned serve.py worker processes on a
+              consistent-hash ring keyed like the prediction cache
+              (hot keys shard across worker caches, not duplicate),
+              least-loaded fallback via the fleet's shared pick
+              policy, one failover redispatch on worker death, and
+              two-phase fleet-wide promote under a cluster epoch that
+              rejects mixed-epoch replies
 
 Imports stay lazy (PEP 562, like utils/): pulling `serve` in a supervisor
 parent must not import jax.
@@ -136,6 +144,14 @@ _EXPORTS = {
                       "build_catalog"),
     "build_tenancy": ("distributedmnist_tpu.serve.tenancy",
                       "build_tenancy"),
+    "Gateway": ("distributedmnist_tpu.serve.gateway", "Gateway"),
+    "HashRing": ("distributedmnist_tpu.serve.gateway", "HashRing"),
+    "ring_key": ("distributedmnist_tpu.serve.gateway", "ring_key"),
+    "gateway_prometheus_exposition": (
+        "distributedmnist_tpu.serve.metrics",
+        "gateway_prometheus_exposition"),
+    "select_member": ("distributedmnist_tpu.serve.fleet",
+                      "select_member"),
 }
 
 __all__ = list(_EXPORTS)

@@ -255,6 +255,29 @@ class PredictionCache:
             log.info("prediction cache invalidated (%s): %d entries "
                      "dropped", reason or "unspecified", dropped)
 
+    def align_epoch(self, epoch: int, reason: Optional[str] = None) -> bool:
+        """Adopt a fleet-assigned invalidation epoch (ISSUE 19: the
+        worker-side landing of the gateway's cluster-epoch fan-out,
+        called only from serve.apply_cluster_epoch). A FORWARD move
+        drops every entry exactly like invalidate() — entries computed
+        under the previous cluster epoch must never serve under the
+        new one — and pins this cache's epoch to the cluster's, so
+        in-flight leader inserts keyed to the old epoch are refused by
+        the insert() check. A replayed or stale epoch (<= current) is
+        a no-op: fan-out retries must not wipe a warm shard. Returns
+        True when the move happened."""
+        with self._lock:
+            if epoch <= self._epoch:
+                return False
+            dropped = len(self._entries)
+            self._entries.clear()
+            self._epoch = epoch
+            self._invalidations += 1
+        log.info("prediction cache aligned to cluster epoch %d (%s): "
+                 "%d entries dropped", epoch, reason or "unspecified",
+                 dropped)
+        return True
+
     def epoch(self) -> int:
         with self._lock:
             return self._epoch

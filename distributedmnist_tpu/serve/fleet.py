@@ -108,6 +108,42 @@ class _Replica:
     failures: int = 0
 
 
+def select_member(active: Sequence[Any], cooled, window: int,
+                  overflow: bool = False,
+                  healthy_only: bool = False) -> Optional[Any]:
+    """The fleet's cost-aware least-loaded pick as a pure function —
+    one policy, two callers: ReplicaSet._pick (in-process replicas)
+    and the gateway's balanced dispatch across worker processes
+    (serve/gateway.py, ISSUE 19). Members need the accounting triple
+    (`rid`, `inflight`, `outstanding_s`, `last_pick`); `cooled` is the
+    breaker predicate (rid -> in cooldown?).
+
+    Healthy members with free window credit win by least outstanding
+    work; every member cooled degrades to least-loaded among active
+    (limp mode — a grim health window is never a self-inflicted
+    outage) unless `healthy_only` (hedge semantics: a duplicate on a
+    sick member is guaranteed wasted work). `overflow` lets the pick
+    exceed the window (rescue semantics). Returns None when no
+    candidate qualifies — selection only: the CALLER reserves the
+    slot under its own lock."""
+    if not active:
+        return None
+    healthy = [m for m in active if not cooled(m.rid)]
+    if healthy_only and not healthy:
+        return None
+    pool = healthy or active        # limp mode
+    free = [m for m in pool if m.inflight < window]
+    cands = free or (pool if overflow else [])
+    if not cands:
+        return None
+    # Ties (idle symmetric members) break by LEAST RECENTLY PICKED —
+    # stateless round-robin. A cumulative-count tiebreak would flood a
+    # freshly rejoined member until its lifetime total caught up with
+    # siblings that served through its absence.
+    return min(cands, key=lambda m: (m.outstanding_s, m.inflight,
+                                     m.last_pick))
+
+
 @dataclasses.dataclass
 class FleetHandle:
     """A dispatched batch plus everything failover needs: the replica
@@ -308,31 +344,27 @@ class ReplicaSet:
                     raise NoReplicaAvailable(
                         "every replica is draining — fleet takes no new "
                         "work")
-                healthy = [r for r in active
-                           if not self.breaker.in_cooldown(r.rid)]
-                if healthy_only and not healthy:
-                    # hedge picks: a duplicate on a breaker-tripped
-                    # sibling is guaranteed wasted work — better no
-                    # hedge than a sick one. Rescues and primary
-                    # dispatch still get the limp-mode fallback below.
-                    return None
-                pool = healthy or active    # limp mode
-                free = [r for r in pool
-                        if r.inflight < self.per_replica_inflight]
-                cands = free or (pool if (not block and overflow) else [])
-                if cands:
-                    # Ties (idle symmetric replicas) break by LEAST
-                    # RECENTLY PICKED — stateless round-robin. A
-                    # cumulative-count tiebreak would flood a freshly
-                    # rejoined replica until its lifetime total caught
-                    # up with siblings that served through its absence.
-                    rep = min(cands, key=lambda r: (
-                        r.outstanding_s, r.inflight, r.last_pick))
+                # the selection policy itself is the shared pure
+                # function (the gateway's balanced dispatch runs the
+                # SAME one across worker processes); reservation stays
+                # here, under this fleet's lock
+                rep = select_member(active, self.breaker.in_cooldown,
+                                    self.per_replica_inflight,
+                                    overflow=(not block and overflow),
+                                    healthy_only=healthy_only)
+                if rep is not None:
                     self._pick_seq += 1
                     rep.last_pick = self._pick_seq
                     rep.inflight += 1
                     rep.outstanding_s += cost_s
                     return rep
+                if healthy_only and all(self.breaker.in_cooldown(r.rid)
+                                        for r in active):
+                    # hedge picks: a duplicate on a breaker-tripped
+                    # sibling is guaranteed wasted work — better no
+                    # hedge than a sick one. Rescues and primary
+                    # dispatch still get limp mode inside the policy.
+                    return None
                 if not block:
                     return None
                 self._cond.wait(0.05)

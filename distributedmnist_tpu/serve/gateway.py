@@ -1,0 +1,1143 @@
+"""Horizontal scale-out gateway (ISSUE 19): a consistent-hash front
+door over N `serve.py` worker processes, with a sharded prediction
+cache and cluster-epoch coordinated promote.
+
+Every scaling layer before this PR lived inside ONE process: the
+replica fleet (ISSUE 6) multiplies engines, the prediction cache
+(ISSUE 10) multiplies goodput on hot keys, the tenant scheduler
+(ISSUE 18) multiplexes models — all behind a single HTTP listener on a
+single Python runtime. This module is the process-level half the
+ROADMAP calls the missing piece: a gateway process that owns the
+public port and routes across a fleet of full serve.py stacks (each
+its own registry/batcher/engine over shared checkpoint storage), the
+way Clipper fronts heterogeneous model containers with one routing
+layer (PAPERS.md).
+
+Routing policy — shard, don't duplicate:
+
+- **Consistent-hash affinity for cacheable traffic.** The ring is
+  keyed by the same `(live version, infer dtype, rows, sha256(body))`
+  identity the PR 10 cache keys entries by (`cache.content_key`), so
+  every repeat of a hot key lands on the SAME worker and the fleet's
+  aggregate cache holds each entry exactly once — N workers buy N
+  distinct cache shards, not N copies of the hottest shard. A miss
+  routed off-ring would compute AND insert the entry on a non-owner
+  (a duplicate by construction), so affinity is the policy for every
+  keyable request; the gateway never speculates on per-key hit state.
+- **Cost-aware least-loaded fallback** for everything that cannot hit
+  a cache: requests with no computable route identity (no live
+  version yet, affinity disabled because the fleet runs uncached) and
+  ring owners that are dead or breaker-cooled. The pick reuses the
+  fleet's policy verbatim (`fleet.select_member`): healthy members
+  with free window credit win by least outstanding work, every member
+  cooled degrades to limp mode, LRU tiebreak.
+- **Failover redispatch.** A worker that dies mid-request (transport
+  error + exited process, or connection refused) gets ONE redispatch
+  to the next owner in ring order before the client sees an error —
+  and the dead worker leaves the ring, so its keys migrate to exactly
+  the workers that absorb its traffic (minimal movement).
+- **Backpressure, composed with tenant admission.** Per-worker
+  in-flight windows bound what the gateway will queue on any one
+  worker; a full owner is a 503 with Retry-After (spilling an
+  affinity key would duplicate its cache entry — shedding is the
+  honest move). Tenant headers (X-Tenant, X-Deadline-Ms,
+  X-Accuracy-Class) pass through untouched: the PR 18 scheduler's
+  429/504 verdicts come back from the worker as-is, so gateway
+  backpressure stacks UNDER tenant admission, never replaces it.
+
+Cluster epoch — no mixed-version window, ever:
+
+The PR 10 cache generalized "promote" to an invalidation epoch inside
+one process; the gateway generalizes it across processes. A fleet-wide
+promote (admin POST /models/promote, or SIGHUP) runs TWO-PHASE:
+prepare (load + pre-warm the version on every worker — slow, traffic
+keeps flowing) then flip (pause admission, drain the gateway's
+in-flight window to zero, promote every worker, fan the new epoch out,
+bump the gateway's own epoch, resume). Workers stamp every /predict
+response with X-Cluster-Epoch; the gateway compares each reply's epoch
+against the epoch it admitted the request under and 503s a mismatch
+(`mixed_epoch_rejected` — asserted zero by the bench: with the
+pause-drain barrier the mismatch path is unreachable unless a worker
+is bypassed or wedged). A rolling version change therefore never
+serves two versions to one client: either the old fleet answered
+before the barrier or the new fleet after it.
+
+The cluster epoch is mutated ONLY inside `promote_fanout` (gateway
+side) and `apply_cluster_epoch` (worker side) — lint DML018 enforces
+the containment the way DML017 pins the tenancy state to its lock.
+
+Observability: gateway spans (`gateway.route` / `gateway.dispatch` /
+`gateway.failover`) join the trace vocabulary, and cross-process
+correlation rides two headers — the gateway sends X-Gateway-Trace-Id
+to the worker and tags its dispatch span with the worker's X-Trace-Id,
+so one request's gateway trace and worker trace name each other from
+both sides. /metrics serves the `dmnist_gateway_*` Prometheus series
+(serve/metrics.py `gateway_prometheus_exposition`).
+
+stdlib-only like serve.py: http.server on the front, pooled
+http.client connections to the workers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import itertools
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Any, Optional, Sequence
+
+from distributedmnist_tpu.analysis.locks import (make_condition, make_lock,
+                                                 make_thread)
+from distributedmnist_tpu.serve import trace
+from distributedmnist_tpu.serve.resilience import (CircuitBreaker,
+                                                   HealthTracker)
+
+log = logging.getLogger("distributedmnist_tpu")
+
+IMAGE_BYTES = 28 * 28
+
+# Tenant/SLO/trace headers forwarded to the worker untouched (ISSUE 18
+# composition: the worker's scheduler sees exactly what the client
+# sent) and the worker response headers surfaced back to the client.
+_FORWARD_HEADERS = ("X-Deadline-Ms", "X-Accuracy-Class", "X-Tenant",
+                    "X-Server-Timing")
+_SURFACE_HEADERS = ("X-Trace-Id", "X-Cluster-Epoch", "Retry-After",
+                    "Server-Timing")
+
+
+class GatewayShed(RuntimeError):
+    """A request the gateway refuses to dispatch (backpressure, empty
+    fleet, promote-pause timeout): 503 semantics, counted by reason."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+        self.status = 503
+
+
+def ring_key(version: Optional[str], infer_dtype: Optional[str],
+             rows: int, digest: bytes) -> bytes:
+    """The ring's hash input for one request — the same identity tuple
+    the PR 10 cache keys entries by (cache.content_key), serialized to
+    bytes. Keeping the identities equal is the whole sharding argument:
+    a key's cache entry lives on a worker if and only if the ring sent
+    every repeat of that key there."""
+    return (f"{version}|{infer_dtype}|{rows}|".encode()
+            + digest)
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (sha256 points).
+
+    Placement is deterministic (pure function of the member set), key
+    movement on join/leave is minimal (a joining member takes keys only
+    FROM successors of its own vnodes; a leaving member's keys move
+    only TO its ring successors — nothing else re-maps), and
+    `owners(key)` yields the failover order: the owner first, then each
+    next distinct member clockwise. Not thread-safe by itself — the
+    Gateway mutates it only under its routing condition."""
+
+    def __init__(self, members: Sequence[str] = (), vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self._members: set = set()
+        self._points: list = []      # sorted [(point, member), ...]
+        for m in members:
+            self.add(m)
+
+    @staticmethod
+    def _hash(data: bytes) -> int:
+        return int.from_bytes(hashlib.sha256(data).digest()[:8], "big")
+
+    def _vnode_points(self, member: str) -> list:
+        return [self._hash(f"{member}#{i}".encode())
+                for i in range(self.vnodes)]
+
+    def add(self, member: str) -> None:
+        if member in self._members:
+            raise ValueError(f"member {member!r} already on the ring")
+        self._members.add(member)
+        for pt in self._vnode_points(member):
+            bisect.insort(self._points, (pt, member))
+
+    def remove(self, member: str) -> None:
+        if member not in self._members:
+            raise KeyError(f"member {member!r} not on the ring")
+        self._members.discard(member)
+        self._points = [p for p in self._points if p[1] != member]
+
+    def members(self) -> list:
+        return sorted(self._members)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def __contains__(self, member: str) -> bool:
+        return member in self._members
+
+    def owners(self, key: bytes, n: Optional[int] = None) -> list:
+        """Distinct members in ring order from the key's successor
+        point: owners(key)[0] is the placement, [1] the first failover
+        target, and so on. Empty ring -> empty list."""
+        if not self._points:
+            return []
+        want = len(self._members) if n is None else min(
+            n, len(self._members))
+        h = self._hash(key)
+        idx = bisect.bisect_right(self._points, (h, "￿"))
+        out: list = []
+        for i in range(len(self._points)):
+            member = self._points[(idx + i) % len(self._points)][1]
+            if member not in out:
+                out.append(member)
+                if len(out) >= want:
+                    break
+        return out
+
+    def owner(self, key: bytes) -> Optional[str]:
+        got = self.owners(key, n=1)
+        return got[0] if got else None
+
+
+class WorkerTransport:
+    """Pooled HTTP/1.1 client to one worker: keep-alive connections
+    reused across requests (the closed-loop bench would otherwise pay
+    a TCP handshake per image), broken connections dropped, never
+    reused. The pool lock guards only list ops — I/O runs outside it."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 75.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._lock = make_lock(f"gateway.pool.{port}")
+        self._free: deque = deque()
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None,
+                headers: Optional[dict] = None,
+                timeout_s: Optional[float] = None) -> tuple:
+        """One round trip: (status, response headers dict, body bytes).
+        Raises OSError/http.client.HTTPException on transport failure —
+        the caller's failover cue."""
+        import http.client
+
+        with self._lock:
+            conn = self._free.popleft() if self._free else None
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port,
+                timeout=(self.timeout_s if timeout_s is None
+                         else timeout_s))
+        elif timeout_s is not None and conn.sock is not None:
+            conn.sock.settimeout(timeout_s)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            out_headers = dict(resp.getheaders())
+            status = resp.status
+        except Exception:
+            conn.close()          # a broken connection is never pooled
+            raise
+        if timeout_s is not None and conn.sock is not None:
+            conn.sock.settimeout(self.timeout_s)
+        with self._lock:
+            self._free.append(conn)
+        return status, out_headers, data
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._free)
+            self._free.clear()
+        for c in conns:
+            c.close()
+
+
+@dataclasses.dataclass
+class _Worker:
+    """One fleet member: its transport plus the live routing
+    accounting, all mutable fields guarded by the Gateway's routing
+    condition. Field names mirror fleet._Replica so the shared pick
+    policy (fleet.select_member) reads both. `outstanding_s` is in ROW
+    units here — the gateway holds no warmup cost tables, so
+    least-outstanding-rows is its cost-aware analogue."""
+
+    rid: str
+    port: int
+    transport: Any
+    proc: Any = None                 # subprocess handle (None in tests)
+    state: str = "active"            # "active" | "dead"
+    inflight: int = 0
+    outstanding_s: float = 0.0
+    last_pick: int = 0
+    dispatched: int = 0
+    rescued: int = 0
+    failures: int = 0
+
+
+class Gateway:
+    """The routing core: admission, ring/least-loaded dispatch,
+    failover, the cluster epoch, and the two-phase promote. HTTP
+    serving and process spawning live in run_gateway() — this class
+    takes any transport-shaped workers, so the unit tests drive it
+    with in-memory fakes (no sockets)."""
+
+    #: bounded wait for a promote flip before a request sheds (the
+    #: flip itself is sub-second: promote + epoch POSTs on warm
+    #: workers — prepare ran before the pause)
+    pause_wait_s = 10.0
+    #: bounded wait for the in-flight window to drain at the flip
+    drain_timeout_s = 30.0
+
+    def __init__(self, workers: Sequence[_Worker],
+                 worker_inflight: int = 8, vnodes: int = 64,
+                 affinity: bool = True,
+                 breaker: Optional[CircuitBreaker] = None,
+                 health: Optional[HealthTracker] = None):
+        if not workers:
+            raise ValueError("a gateway needs at least one worker")
+        if worker_inflight < 1:
+            raise ValueError(
+                f"worker_inflight must be >= 1, got {worker_inflight}")
+        self._cond = make_condition("gateway.route")
+        # Serializes admin fan-outs (load/promote/SIGHUP): held across
+        # multi-second worker warmups BY DESIGN — admin threads only,
+        # never the dispatch path.
+        self._admin = make_lock("gateway.admin", blocking_ok=True)
+        self._workers: dict = {w.rid: w for w in workers}
+        if len(self._workers) != len(workers):
+            raise ValueError("duplicate worker rid")
+        self.worker_inflight = worker_inflight
+        self.affinity = affinity
+        self.ring = HashRing([w.rid for w in workers], vnodes=vnodes)
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            window_s=5.0, min_requests=8, failure_ratio=0.5,
+            cooldown_s=5.0)
+        self.health = health if health is not None else HealthTracker()
+        self._cluster_epoch = 0
+        self._live_version: Optional[str] = None
+        self._live_dtype: Optional[str] = None
+        self._paused = False
+        self._pick_seq = 0
+        self._rid_seq = itertools.count(1)
+        # counters (all under self._cond)
+        self._requests = 0
+        self._routed_affinity = 0
+        self._routed_balanced = 0
+        self._failovers = 0
+        self._failover_rescued = 0
+        self._backpressure_503 = 0
+        self._paused_503 = 0
+        self._mixed_epoch_rejected = 0
+        self._worker_deaths = 0
+        self._promotes = 0
+
+    # -- boot --------------------------------------------------------------
+
+    def start(self) -> None:
+        """Fan the initial epoch out so every worker stamps responses
+        from request one (a stampless reply would be indistinguishable
+        from a pre-gateway worker), then learn the live route identity
+        for ring keying (best-effort: workers may still be warming —
+        refresh_route() is retried per request until it lands)."""
+        for w in self._active():
+            try:
+                w.transport.request(
+                    "POST", "/cluster/epoch",
+                    json.dumps({"epoch": self._cluster_epoch}).encode(),
+                    {"Content-Type": "application/json"})
+            except Exception as e:
+                log.warning("gateway: epoch seed to %s failed: %s",
+                            w.rid, e)
+        self.refresh_route()
+
+    def refresh_route(self) -> None:
+        """Re-learn (live_version, live_infer_dtype) from the first
+        worker that answers /healthz — the ring-key identity. Workers
+        promote in lockstep (the fan-out is the only admin path), so
+        any one worker's answer speaks for the fleet."""
+        for w in self._active():
+            try:
+                _, _, body = w.transport.request("GET", "/healthz",
+                                                 timeout_s=5.0)
+                payload = json.loads(body)
+            except Exception:
+                continue
+            if payload.get("live_version") is not None:
+                with self._cond:
+                    self._live_version = payload["live_version"]
+                    self._live_dtype = payload.get("live_infer_dtype")
+                return
+
+    def _active(self) -> list:
+        with self._cond:
+            return [w for w in self._workers.values()
+                    if w.state == "active"]
+
+    # -- admission + dispatch ----------------------------------------------
+
+    def _admit(self, key: Optional[bytes], rows: int) -> tuple:
+        """Pick + reserve a worker under the routing condition; returns
+        (admission epoch, worker, failover order). Raises GatewayShed
+        on backpressure / pause timeout / empty fleet. The slot is
+        reserved HERE, under the lock, so concurrent admits can never
+        oversubscribe a window — exactly the fleet's reservation
+        discipline."""
+        from distributedmnist_tpu.serve.fleet import select_member
+
+        with self._cond:
+            t_end = time.monotonic() + self.pause_wait_s
+            while self._paused:
+                if time.monotonic() >= t_end:
+                    self._paused_503 += 1
+                    raise GatewayShed(
+                        "promote_pause",
+                        "fleet promote in progress; retry")
+                self._cond.wait(0.05)
+            self._requests += 1
+            active = [w for w in self._workers.values()
+                      if w.state == "active"]
+            if not active:
+                raise GatewayShed("no_workers",
+                                  "every worker is dead")
+            pick = None
+            failover: list = []
+            if key is not None:
+                order = [rid for rid in self.ring.owners(key)
+                         if rid in self._workers
+                         and self._workers[rid].state == "active"]
+                cands = [self._workers[rid] for rid in order]
+                # first non-cooled owner in ring order; all cooled
+                # degrades to the raw ring order (limp mode — the
+                # fleet's rule: a grim health window is never a
+                # self-inflicted outage)
+                pick = next((w for w in cands
+                             if not self.breaker.in_cooldown(w.rid)),
+                            cands[0] if cands else None)
+                if pick is not None:
+                    if pick.inflight >= self.worker_inflight:
+                        # The owner is saturated. Spilling this key to
+                        # a sibling would compute AND cache it there —
+                        # a duplicate entry by construction — so the
+                        # gateway sheds instead: backpressure IS the
+                        # sharding contract under overload.
+                        self._backpressure_503 += 1
+                        raise GatewayShed(
+                            "backpressure",
+                            f"worker {pick.rid} (ring owner) is at its "
+                            f"in-flight window ({self.worker_inflight})")
+                    failover = [rid for rid in order if rid != pick.rid]
+                    self._routed_affinity += 1
+            if pick is None:
+                pick = select_member(active, self.breaker.in_cooldown,
+                                     self.worker_inflight)
+                if pick is None:
+                    self._backpressure_503 += 1
+                    raise GatewayShed(
+                        "backpressure",
+                        "every worker is at its in-flight window")
+                failover = [w.rid for w in active if w.rid != pick.rid]
+                self._routed_balanced += 1
+            self._pick_seq += 1
+            pick.last_pick = self._pick_seq
+            pick.inflight += 1
+            pick.outstanding_s += rows
+            return self._cluster_epoch, pick, failover
+
+    def _release(self, w: _Worker, rows: int) -> None:
+        with self._cond:
+            w.inflight -= 1
+            w.outstanding_s = max(w.outstanding_s - rows, 0.0)
+            self._cond.notify_all()
+
+    def _record(self, w: _Worker, ok: bool, rows: int,
+                latency_s: Optional[float] = None) -> None:
+        self.health.record(w.rid, ok, n=rows, latency_s=latency_s)
+        if not ok:
+            with self._cond:
+                w.failures += 1
+        if self.breaker.record(w.rid, ok, n=rows):
+            log.warning("gateway: worker %s TRIPPED its breaker — "
+                        "routed around for %.1fs", w.rid,
+                        self.breaker.cooldown_s)
+
+    def _mark_dead(self, w: _Worker) -> None:
+        """A worker whose process exited (or refuses connections)
+        leaves the pick set AND the ring — its keys migrate to their
+        next owners, which is exactly where its in-flight requests
+        fail over to."""
+        with self._cond:
+            if w.state == "dead":
+                return
+            w.state = "dead"
+            self._worker_deaths += 1
+            if w.rid in self.ring:
+                self.ring.remove(w.rid)
+            self._cond.notify_all()
+        log.warning("gateway: worker %s (port %d) is DEAD — removed "
+                    "from the ring, keys migrate to ring successors",
+                    w.rid, w.port)
+
+    def _is_death(self, w: _Worker, exc: BaseException) -> bool:
+        if w.proc is not None and w.proc.poll() is not None:
+            return True
+        return isinstance(exc, ConnectionRefusedError)
+
+    def handle_predict(self, body: bytes, headers: dict) -> tuple:
+        """Route one /predict: returns (status, response headers,
+        response body bytes). Transport failure on the picked worker
+        gets one failover redispatch to the next ring owner; a reply
+        stamped with a different epoch than the request was admitted
+        under is rejected (503) — mixed-epoch replies must never reach
+        a client."""
+        t0 = time.monotonic()
+        if not body or len(body) % IMAGE_BYTES:
+            return (400, {}, json.dumps(
+                {"error": "body must be n*784 raw uint8 pixel "
+                          "bytes"}).encode())
+        rows = len(body) // IMAGE_BYTES
+        tracer = trace.active()
+        tid = None
+        rid = 0
+        if tracer is not None:
+            rid = next(self._rid_seq)
+            tid = tracer.start_request(rid, rows=rows, t0=t0)
+        fwd = {k: headers[k] for k in _FORWARD_HEADERS if k in headers}
+        fwd["Content-Type"] = "application/octet-stream"
+        if tid is not None:
+            fwd["X-Gateway-Trace-Id"] = tid
+        error: Optional[BaseException] = None
+        try:
+            status, rhdrs, rbody, worker = self._route_once(
+                body, fwd, rows, rid)
+        except GatewayShed as e:
+            error = e
+            out = {"Retry-After": "1"}
+            if tid is not None:
+                out["X-Gateway-Trace-Id"] = tid
+            return (503, out, json.dumps(
+                {"error": str(e), "reason": e.reason}).encode())
+        except Exception as e:
+            error = e
+            out = {"X-Gateway-Trace-Id": tid} if tid is not None else {}
+            return (502, out, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}).encode())
+        finally:
+            if tracer is not None:
+                tracer.finish_request(rid, error=error)
+        out = {k: rhdrs[k] for k in _SURFACE_HEADERS if k in rhdrs}
+        out["X-Gateway-Worker"] = worker.rid
+        if tid is not None:
+            out["X-Gateway-Trace-Id"] = tid
+        return status, out, rbody
+
+    def _route_once(self, body: bytes, fwd: dict, rows: int,
+                    rid: int) -> tuple:
+        """Admit, dispatch, failover-once, epoch-check. Returns
+        (status, worker headers, worker body, worker)."""
+        with self._cond:
+            version, dtype = self._live_version, self._live_dtype
+        if version is None:
+            # the route identity may simply not be learned yet
+            # (workers were warming at start()) — retry cheaply
+            self.refresh_route()
+            with self._cond:
+                version, dtype = self._live_version, self._live_dtype
+        key = None
+        if self.affinity and version is not None:
+            key = ring_key(version, dtype, rows,
+                           hashlib.sha256(body).digest())
+        sp = trace.begin_span("gateway.route", rids=[rid], rows=rows)
+        try:
+            epoch, worker, failover = self._admit(key, rows)
+        finally:
+            trace.end_span(sp)
+        status = rhdrs = rbody = None
+        sp = trace.begin_span("gateway.dispatch", rids=[rid],
+                              worker=worker.rid)
+        try:
+            t_d0 = time.monotonic()
+            try:
+                status, rhdrs, rbody = worker.transport.request(
+                    "POST", "/predict", body, fwd)
+            except Exception as e:
+                self._release(worker, rows)
+                self._record(worker, False, rows)
+                if self._is_death(worker, e):
+                    self._mark_dead(worker)
+                trace.end_span(sp, error=type(e).__name__)
+                sp = None
+                status, rhdrs, rbody, worker = self._failover(
+                    body, fwd, rows, rid, worker, failover, e)
+            else:
+                self._release(worker, rows)
+                self._record(worker, status < 500 or status in (503, 504),
+                             rows, latency_s=time.monotonic() - t_d0)
+                with self._cond:
+                    worker.dispatched += 1
+                if sp is not None and "X-Trace-Id" in rhdrs:
+                    # cross-process join: the gateway span names the
+                    # worker's trace, the worker's trace carries the
+                    # gateway id via X-Gateway-Trace-Id
+                    sp.tags["worker_trace_id"] = rhdrs["X-Trace-Id"]
+        finally:
+            trace.end_span(sp)
+        reply_epoch = rhdrs.get("X-Cluster-Epoch")
+        if status == 200 and reply_epoch is not None \
+                and int(reply_epoch) != epoch:
+            with self._cond:
+                self._mixed_epoch_rejected += 1
+            log.warning(
+                "gateway: REJECTED mixed-epoch reply from %s (admitted "
+                "epoch %d, reply epoch %s)", worker.rid, epoch,
+                reply_epoch)
+            raise GatewayShed(
+                "mixed_epoch",
+                f"reply computed under cluster epoch {reply_epoch}, "
+                f"request admitted under {epoch}; retry")
+        return status, rhdrs, rbody, worker
+
+    def _failover(self, body: bytes, fwd: dict, rows: int, rid: int,
+                  failed: _Worker, failover: list,
+                  cause: BaseException) -> tuple:
+        """ONE redispatch to the next ring owner (or the next
+        least-loaded active worker on the balanced path). A rescue may
+        transiently exceed the window (overflow), like the fleet's —
+        refusing the rescue for credit would turn one death into two
+        failures."""
+        with self._cond:
+            self._failovers += 1
+            rescue = next(
+                (self._workers[r] for r in failover
+                 if r in self._workers
+                 and self._workers[r].state == "active"), None)
+            if rescue is not None:
+                self._pick_seq += 1
+                rescue.last_pick = self._pick_seq
+                rescue.inflight += 1
+                rescue.outstanding_s += rows
+        if rescue is None:
+            raise GatewayShed(
+                "no_workers",
+                f"worker {failed.rid} died mid-request "
+                f"({type(cause).__name__}) and no sibling remains")
+        sp = trace.begin_span("gateway.failover", rids=[rid],
+                              failed=failed.rid, rescue=rescue.rid)
+        try:
+            t0 = time.monotonic()
+            try:
+                status, rhdrs, rbody = rescue.transport.request(
+                    "POST", "/predict", body, fwd)
+            except Exception as e:
+                self._release(rescue, rows)
+                self._record(rescue, False, rows)
+                if self._is_death(rescue, e):
+                    self._mark_dead(rescue)
+                raise RuntimeError(
+                    f"worker {failed.rid} died mid-request "
+                    f"({type(cause).__name__}); failover to "
+                    f"{rescue.rid} also failed "
+                    f"({type(e).__name__}: {e})") from e
+            self._release(rescue, rows)
+            self._record(rescue, status < 500 or status in (503, 504),
+                         rows, latency_s=time.monotonic() - t0)
+            with self._cond:
+                rescue.dispatched += 1
+                rescue.rescued += 1
+                self._failover_rescued += 1
+        finally:
+            trace.end_span(sp)
+        return status, rhdrs, rbody, rescue
+
+    # -- admin: fleet-wide model lifecycle ---------------------------------
+
+    def load_fanout(self, body: dict) -> tuple:
+        """Phase-1-only admin surface (POST /models/load): load +
+        pre-warm on EVERY active worker, no routing change, no epoch
+        change. Aborts on the first failure — a fleet where only some
+        workers hold the candidate would turn the later flip into a
+        partial outage. Returns (status, payload)."""
+        with self._admin:
+            return self._load_fanout_locked(body)
+
+    def _load_fanout_locked(self, body: dict) -> tuple:
+        live = self._active()
+        if not live:
+            return 503, {"error": "every worker is dead"}
+        results = {}
+        for w in live:
+            try:
+                st, _, rbody = w.transport.request(
+                    "POST", "/models/load",
+                    json.dumps(body).encode(),
+                    {"Content-Type": "application/json"},
+                    timeout_s=600.0)
+            except Exception as e:
+                return 502, {
+                    "error": f"prepare failed on {w.rid}: "
+                             f"{type(e).__name__}: {e}",
+                    "prepared": results}
+            payload = _json_or_raw(rbody)
+            if st != 200:
+                return st, {
+                    "error": f"prepare failed on {w.rid}",
+                    "worker_response": payload,
+                    "prepared": results}
+            results[w.rid] = payload
+        versions = {r.get("version") for r in results.values()
+                    if isinstance(r, dict)}
+        return 200, {"prepared": results,
+                     "version": (versions.pop()
+                                 if len(versions) == 1 else None),
+                     "workers": sorted(results)}
+
+    def promote_fanout(self, version: Optional[str] = None,
+                       load: Optional[dict] = None,
+                       infer_dtype: Optional[str] = None) -> tuple:
+        """The fleet-wide promote — and the ONLY place the gateway's
+        cluster epoch mutates (lint DML018). Two-phase:
+
+        phase 1 (prepare): when `load` is given, load + pre-warm it on
+        every worker while traffic keeps flowing (a prior load_fanout
+        also satisfies this phase). No routing change yet.
+
+        phase 2 (flip): pause admission, drain the gateway's in-flight
+        window to zero, promote every worker, fan the bumped epoch
+        out, bump the gateway's own epoch, resume. Requests admitted
+        before the pause completed against the OLD fleet; requests
+        after resume dispatch against the NEW one — the mixed-epoch
+        window is empty by construction, and the per-reply epoch check
+        in handle_predict stays as the tripwire.
+
+        A mid-flip worker failure rolls the already-flipped workers
+        back to the old version before resuming (a worker that also
+        fails the rollback is marked dead — it can only serve stamped
+        replies the epoch check rejects)."""
+        with self._admin:
+            live = self._active()
+            if not live:
+                return 503, {"error": "every worker is dead"}
+            if load is not None:
+                st, payload = self._load_fanout_locked(load)
+                if st != 200:
+                    return st, payload
+                if version is None:
+                    version = payload.get("version")
+            if not version:
+                return 400, {"error": "no 'version' (and no unambiguous "
+                                      "prepared version to infer)"}
+            with self._cond:
+                old_version = self._live_version
+                new_epoch = self._cluster_epoch + 1
+                self._paused = True
+                self._cond.notify_all()
+            try:
+                self._drain_inflight()
+                flipped: list = []
+                promote_body = {"version": version, "mode": "live"}
+                if infer_dtype is not None:
+                    promote_body["infer_dtype"] = infer_dtype
+                for w in live:
+                    st, _, rbody = _admin_post(w, "/models/promote",
+                                               promote_body)
+                    if st != 200:
+                        self._rollback(flipped, old_version)
+                        return 409, {
+                            "error": f"promote failed on {w.rid} "
+                                     "(fleet rolled back)",
+                            "worker_response": _json_or_raw(rbody)}
+                    flipped.append(w)
+                for w in live:
+                    st, _, rbody = _admin_post(
+                        w, "/cluster/epoch", {"epoch": new_epoch})
+                    if st != 200:
+                        # a worker serving the new version under the
+                        # old epoch would stamp replies the epoch
+                        # check rejects — remove it rather than serve
+                        # rejectable answers from it
+                        self._mark_dead(w)
+                with self._cond:
+                    self._cluster_epoch = new_epoch
+                    self._live_version = version
+                    self._promotes += 1
+            finally:
+                with self._cond:
+                    self._paused = False
+                    self._cond.notify_all()
+            self.refresh_route()     # live dtype may have changed
+            log.info("gateway: fleet promoted to %s, cluster epoch %d "
+                     "(%d workers)", version, new_epoch, len(live))
+            return 200, {"promoted": version,
+                         "cluster_epoch": new_epoch,
+                         "workers": [w.rid for w in live]}
+
+    def _drain_inflight(self) -> None:
+        with self._cond:
+            t_end = time.monotonic() + self.drain_timeout_s
+            while any(w.inflight for w in self._workers.values()):
+                if time.monotonic() >= t_end:
+                    raise RuntimeError(
+                        "gateway in-flight window failed to drain for "
+                        "the promote flip")
+                self._cond.wait(0.05)
+
+    def _rollback(self, flipped: list, old_version: Optional[str]) -> None:
+        if old_version is None:
+            return
+        for w in flipped:
+            try:
+                st, _, _ = _admin_post(w, "/models/promote",
+                                       {"version": old_version})
+                if st != 200:
+                    self._mark_dead(w)
+            except Exception:
+                self._mark_dead(w)
+
+    # -- observability -----------------------------------------------------
+
+    def healthz(self) -> tuple:
+        """Fleet health: 200 while at least one worker answers ok.
+        Worker rows carry the per-worker port + live version + epoch —
+        the bench reads worker ports from here to poll per-worker cache
+        counters directly."""
+        workers = []
+        any_ok = False
+        for w in list(self._workers.values()):
+            row = {"worker": w.rid, "port": w.port, "state": w.state}
+            if w.state == "active":
+                try:
+                    st, _, body = w.transport.request(
+                        "GET", "/healthz", timeout_s=5.0)
+                    payload = json.loads(body)
+                    row.update(
+                        ok=bool(payload.get("ok")),
+                        live_version=payload.get("live_version"),
+                        live_infer_dtype=payload.get("live_infer_dtype"),
+                        cluster_epoch=payload.get("cluster_epoch"),
+                        state_detail=payload.get("state"))
+                    any_ok = any_ok or bool(payload.get("ok"))
+                except Exception as e:
+                    row.update(ok=False,
+                               error=f"{type(e).__name__}: {e}")
+            else:
+                row["ok"] = False
+            workers.append(row)
+        with self._cond:
+            payload = {
+                "ok": any_ok,
+                "cluster_epoch": self._cluster_epoch,
+                "live_version": self._live_version,
+                "paused": self._paused,
+                "workers": workers,
+            }
+        return (200 if any_ok else 503), payload
+
+    def snapshot(self) -> dict:
+        """The dmnist_gateway_* source of truth: JSON /metrics block,
+        Prometheus exposition input, and the gateway_summary exit
+        record."""
+        with self._cond:
+            per_worker = [
+                {"worker": w.rid, "port": w.port, "state": w.state,
+                 "inflight": w.inflight,
+                 "outstanding_rows": w.outstanding_s,
+                 "dispatched": w.dispatched, "rescued": w.rescued,
+                 "failures": w.failures}
+                for w in self._workers.values()]
+            return {
+                "workers": len(self._workers),
+                "workers_active": sum(
+                    1 for w in self._workers.values()
+                    if w.state == "active"),
+                "cluster_epoch": self._cluster_epoch,
+                "live_version": self._live_version,
+                "live_infer_dtype": self._live_dtype,
+                "paused": self._paused,
+                "worker_inflight": self.worker_inflight,
+                "affinity": self.affinity,
+                "requests": self._requests,
+                "routed_affinity": self._routed_affinity,
+                "routed_balanced": self._routed_balanced,
+                "failovers": self._failovers,
+                "failover_rescued": self._failover_rescued,
+                "backpressure_503": self._backpressure_503,
+                "paused_503": self._paused_503,
+                "mixed_epoch_rejected": self._mixed_epoch_rejected,
+                "worker_deaths": self._worker_deaths,
+                "promotes": self._promotes,
+                "per_worker": per_worker,
+                "health": self.health.snapshot(),
+                "breaker": self.breaker.snapshot(),
+            }
+
+
+def _admin_post(w: _Worker, path: str, body: dict) -> tuple:
+    return w.transport.request(
+        "POST", path, json.dumps(body).encode(),
+        {"Content-Type": "application/json"}, timeout_s=600.0)
+
+
+def _json_or_raw(body: bytes):
+    try:
+        return json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return body.decode("utf-8", "replace")
+
+
+# -- process spawning + the HTTP front door --------------------------------
+
+
+def worker_argv(gateway_argv: Sequence[str]) -> list:
+    """The worker command line: the gateway's own argv with the
+    gateway-layer flags stripped and an ephemeral port appended —
+    every serving flag (--model, --serve-cache, --serve-max-batch,
+    --checkpoint-dir, ...) forwards verbatim, so a worker is exactly
+    the serve.py the operator configured, times N."""
+    takes_value = {"--gateway", "--gateway-worker-inflight",
+                   "--gateway-vnodes", "--port"}
+    out: list = []
+    skip = False
+    for a in gateway_argv:
+        if skip:
+            skip = False
+            continue
+        if a in takes_value:
+            skip = True
+            continue
+        if any(a.startswith(f + "=") for f in takes_value):
+            continue
+        out.append(a)
+    return out + ["--port", "0"]
+
+
+def spawn_worker(rid: str, argv: Sequence[str],
+                 ready_timeout_s: float = 180.0) -> _Worker:
+    """Start one serve.py worker subprocess and wait for its
+    serve_ready line (printed after bind, BEFORE warmup — warm state
+    is polled via /healthz). stderr passes through to the gateway's
+    stderr (worker logs stay visible); stdout is drained on a thread
+    so heartbeat lines can never fill the pipe and wedge the worker."""
+    serve_py = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "serve.py")
+    proc = subprocess.Popen(
+        [sys.executable, serve_py] + list(argv),
+        stdout=subprocess.PIPE, text=True)
+    port = None
+    t_end = time.monotonic() + ready_timeout_s
+    while time.monotonic() < t_end:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "serve_ready":
+            port = int(rec["port"])
+            break
+    if port is None:
+        proc.terminate()
+        raise RuntimeError(
+            f"worker {rid} printed no serve_ready line within "
+            f"{ready_timeout_s:.0f}s (exit code {proc.poll()})")
+
+    def _drain():
+        for line in proc.stdout:
+            line = line.rstrip()
+            if line:
+                print(json.dumps({"metric": "worker_line",
+                                  "worker": rid, "line": line}),
+                      flush=True)
+
+    make_thread(target=_drain, name=f"gateway-drain-{rid}",
+                daemon=True).start()
+    return _Worker(rid=rid, port=port, proc=proc,
+                   transport=WorkerTransport("127.0.0.1", port))
+
+
+def run_gateway(args, argv: Sequence[str]) -> int:
+    """serve.py --gateway N main loop: spawn the workers, bind the
+    front door, announce gateway_ready, route until SIGTERM. SIGHUP
+    fans the checkpoint reload out fleet-wide through the two-phase
+    promote (the single-process serve.py semantic, generalized)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from distributedmnist_tpu.serve.metrics import \
+        gateway_prometheus_exposition
+
+    n = args.gateway_workers
+    wargv = worker_argv(argv)
+    log.info("gateway: spawning %d workers: serve.py %s", n,
+             " ".join(wargv))
+    workers: list = []
+    try:
+        for i in range(n):
+            workers.append(spawn_worker(f"w{i}", wargv))
+    except Exception:
+        for w in workers:
+            if w.proc is not None:
+                w.proc.terminate()
+        raise
+    gw = Gateway(workers,
+                 worker_inflight=args.gateway_worker_inflight,
+                 vnodes=args.gateway_vnodes,
+                 affinity=bool(args.serve_cache))
+    if getattr(args, "serve_trace", False):
+        # The gateway runs its OWN tracer (workers each run theirs —
+        # --serve-trace forwards to them too); the X-Gateway-Trace-Id
+        # / X-Trace-Id header exchange in handle_predict joins the two
+        # processes' traces from both sides.
+        trace.install(trace.Tracer(
+            capacity=args.serve_trace_capacity,
+            sample=args.serve_trace_sample,
+            slo_ms=args.serve_slo_ms, seed=args.seed))
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            pass
+
+        def _send(self, code: int, payload: dict,
+                  extra: Optional[dict] = None) -> None:
+            self._send_bytes(code, json.dumps(payload).encode(),
+                             "application/json", extra)
+
+        def _send_bytes(self, code: int, body: bytes,
+                        content_type: str,
+                        extra: Optional[dict] = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json_body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) if length else b""
+            body = json.loads(raw) if raw.strip() else {}
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            return body
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                code, payload = gw.healthz()
+                self._send(code, payload)
+            elif self.path == "/trace" or self.path.startswith("/trace?"):
+                tracer = trace.active()
+                if tracer is None:
+                    self._send(409, {
+                        "error": "tracing is not enabled; restart with "
+                                 "--serve-trace"})
+                else:
+                    self._send(200, tracer.export_chrome())
+            elif (self.path == "/metrics"
+                  or self.path.startswith("/metrics?")):
+                snap = gw.snapshot()
+                if "format=prometheus" in self.path:
+                    self._send_bytes(
+                        200, gateway_prometheus_exposition(snap).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._send(200, snap)
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path == "/predict":
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                status, hdrs, rbody = gw.handle_predict(
+                    body, dict(self.headers))
+                self._send_bytes(status, rbody, "application/json",
+                                 hdrs)
+            elif self.path == "/models/load":
+                self._admin(gw.load_fanout)
+            elif self.path == "/models/promote":
+                self._admin(lambda b: gw.promote_fanout(
+                    version=b.get("version"), load=b.get("load"),
+                    infer_dtype=b.get("infer_dtype")))
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def _admin(self, fn):
+            try:
+                body = self._json_body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            try:
+                code, payload = fn(body)
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(code, payload)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    bound = srv.server_address[1]
+    print(json.dumps({"metric": "gateway_ready", "port": bound,
+                      "workers": n,
+                      "worker_ports": [w.port for w in workers]}),
+          flush=True)
+    gw.start()
+
+    stop = threading.Event()
+
+    def _beat():
+        while not stop.wait(args.metrics_every):
+            print(json.dumps({"metric": "gateway_stats",
+                              **gw.snapshot()}), flush=True)
+
+    make_thread(target=_beat, name="gateway-heartbeat",
+                daemon=True).start()
+
+    def _shutdown(signum, frame):
+        make_thread(target=srv.shutdown, name="gateway-shutdown",
+                    daemon=True).start()
+
+    def _reload(signum, frame):
+        def run():
+            code, payload = gw.promote_fanout(load={})
+            if code == 200:
+                log.info("gateway SIGHUP reload: %s", payload)
+            else:
+                log.error("gateway SIGHUP reload failed: %s", payload)
+
+        make_thread(target=run, name="gateway-reload",
+                    daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    signal.signal(signal.SIGHUP, _reload)
+    try:
+        srv.serve_forever(poll_interval=0.2)
+    finally:
+        stop.set()
+        srv.server_close()
+        for w in workers:
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+            w.transport.close()
+    print(json.dumps({"metric": "gateway_summary", "port": bound,
+                      **gw.snapshot()}), flush=True)
+    return 0

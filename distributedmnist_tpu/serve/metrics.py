@@ -1163,3 +1163,93 @@ def prometheus_exposition(snapshot: dict,
             out.append(_prom_line(name + "_count", {"stage": stage},
                                   h["count"]))
     return "\n".join(out) + "\n"
+
+
+_GATEWAY_PROM_HELP = {
+    "dmnist_gateway_requests_total":
+        "Requests admitted by the gateway's routing layer.",
+    "dmnist_gateway_routed_affinity_total":
+        "Requests routed to their consistent-hash ring owner (the "
+        "sharded-cache path).",
+    "dmnist_gateway_routed_balanced_total":
+        "Requests routed by the cost-aware least-loaded fallback "
+        "(no computable ring key, or owners dead/cooled).",
+    "dmnist_gateway_failovers_total":
+        "Mid-request worker failures that entered the one-redispatch "
+        "failover path.",
+    "dmnist_gateway_failover_rescued_total":
+        "Failovers whose redispatch to the next ring owner answered.",
+    "dmnist_gateway_backpressure_503_total":
+        "Requests shed because the target worker's in-flight window "
+        "was full (spilling an affinity key would duplicate its "
+        "cache entry).",
+    "dmnist_gateway_paused_503_total":
+        "Requests shed waiting out a fleet-promote admission pause.",
+    "dmnist_gateway_mixed_epoch_rejected_total":
+        "Worker replies rejected because their X-Cluster-Epoch did "
+        "not match the epoch the request was admitted under (must "
+        "stay zero; the two-phase promote barrier makes the path "
+        "unreachable).",
+    "dmnist_gateway_worker_deaths_total":
+        "Workers removed from the ring after dying (process exit or "
+        "connection refused).",
+    "dmnist_gateway_promotes_total":
+        "Completed fleet-wide two-phase promotes.",
+    "dmnist_gateway_cluster_epoch":
+        "The gateway's current cluster epoch (bumped once per "
+        "fleet-wide promote flip).",
+    "dmnist_gateway_workers": "Workers spawned (alive or dead).",
+    "dmnist_gateway_workers_active": "Workers in the dispatch set.",
+    "dmnist_gateway_worker_inflight":
+        "Requests currently dispatched to each worker.",
+    "dmnist_gateway_worker_dispatched_total":
+        "Requests each worker answered (including rescues).",
+    "dmnist_gateway_worker_rescued_total":
+        "Failover rescues each worker absorbed.",
+    "dmnist_gateway_worker_failures_total":
+        "Failed round trips attributed to each worker.",
+}
+
+
+def gateway_prometheus_exposition(snapshot: dict) -> str:
+    """Flatten Gateway.snapshot() into Prometheus text format — the
+    `dmnist_gateway_*` series (ISSUE 19), same discipline as
+    prometheus_exposition above: stable names, # HELP/# TYPE pairs,
+    None-valued samples skipped. Per-worker series are labelled
+    worker=<rid> so a dashboard can see the ring's shard balance and
+    which worker absorbed a failover."""
+    out: list[str] = []
+
+    def emit(name: str, mtype: str, samples) -> None:
+        rows = [(labels, v) for labels, v in samples if v is not None]
+        if not rows:
+            return
+        help_text = _GATEWAY_PROM_HELP.get(
+            name,
+            name.removeprefix("dmnist_gateway_").replace("_", " ") + ".")
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, v in rows:
+            out.append(_prom_line(name, labels, v))
+
+    s = snapshot
+    for key in ("requests", "routed_affinity", "routed_balanced",
+                "failovers", "failover_rescued", "backpressure_503",
+                "paused_503", "mixed_epoch_rejected", "worker_deaths",
+                "promotes"):
+        emit(f"dmnist_gateway_{key}_total", "counter", [({}, s.get(key))])
+    emit("dmnist_gateway_cluster_epoch", "gauge",
+         [({}, s.get("cluster_epoch"))])
+    emit("dmnist_gateway_workers", "gauge", [({}, s.get("workers"))])
+    emit("dmnist_gateway_workers_active", "gauge",
+         [({}, s.get("workers_active"))])
+    per = s.get("per_worker") or []
+    emit("dmnist_gateway_worker_inflight", "gauge",
+         [({"worker": w["worker"]}, w.get("inflight")) for w in per])
+    emit("dmnist_gateway_worker_dispatched_total", "counter",
+         [({"worker": w["worker"]}, w.get("dispatched")) for w in per])
+    emit("dmnist_gateway_worker_rescued_total", "counter",
+         [({"worker": w["worker"]}, w.get("rescued")) for w in per])
+    emit("dmnist_gateway_worker_failures_total", "counter",
+         [({"worker": w["worker"]}, w.get("failures")) for w in per])
+    return "\n".join(out) + "\n"

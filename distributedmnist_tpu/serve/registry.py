@@ -372,6 +372,17 @@ class ModelRegistry:
 
     # -- loading -----------------------------------------------------------
 
+    def add_fresh(self, version: Optional[str] = None,
+                  seed: int = 0) -> ModelVersion:
+        """Register + pre-warm a fresh-initialized param set — the
+        bootstrap fallback's param source behind the full add() warmup
+        gate, as an admin surface (ISSUE 19: POST /models/load
+        {"fresh": ...}). A gateway bench stages a promotable second
+        version on EVERY worker of a fleet this way: same seed, same
+        params, no shared trained checkpoint required."""
+        return self.add(self.factory.init_params(seed), version=version,
+                        source="fresh-init")
+
     def add(self, params, version: Optional[str] = None,
             source: str = "direct", step: Optional[int] = None
             ) -> ModelVersion:

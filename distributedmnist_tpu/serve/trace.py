@@ -94,6 +94,18 @@ Span name table (stage -> what it times -> mechanism):
     cascade.escalate        zero-width escalation marker: the margin
                             partition's decision point, tagged with the
                             calibrated threshold and escalated rows
+    gateway.route           gateway process (ISSUE 19): the routing
+                            decision — ring-affinity or least-loaded
+                            pick, including any backpressure/promote-
+                            pause wait
+    gateway.dispatch        the worker HTTP round trip; tagged
+                            worker=<rid> and worker_trace_id=<the
+                            worker's X-Trace-Id>, while the worker's
+                            own trace carries the gateway's id from
+                            the X-Gateway-Trace-Id request header —
+                            cross-process correlation from both sides
+    gateway.failover        the one rescue redispatch after a worker
+                            died mid-request
 """
 
 from __future__ import annotations
@@ -154,6 +166,15 @@ STAGE_OF = {
     # zero-width, priority only for deterministic attribution order
     "cascade.stage": ("cascade", 5),
     "cascade.escalate": ("cascade", 6),
+    # gateway process (ISSUE 19): route = ring/least-loaded pick +
+    # admission (backpressure/pause waits land here); dispatch = the
+    # worker round trip, tagged with the worker's own X-Trace-Id so
+    # the two processes' traces name each other; failover = the one
+    # rescue redispatch after a mid-request worker death, high
+    # priority like the fleet's rescues
+    "gateway.route": ("route", 30),
+    "gateway.dispatch": ("upstream", 20),
+    "gateway.failover": ("rescue", 80),
 }
 
 

@@ -12,6 +12,15 @@ Two modes:
 - --port P: serve HTTP on P (0 picks an ephemeral port, announced as a
   {"metric": "serve_ready", "port": ...} JSON line on stdout). stdlib
   http.server only — the container installs nothing.
+- --gateway N (ISSUE 19, serve/gateway.py): this process becomes a
+  front door instead — it spawns N full serve.py workers (every other
+  serving flag forwards to them verbatim), routes /predict across
+  them on a consistent-hash ring keyed like the prediction cache
+  (hot keys shard, not duplicate), and coordinates fleet-wide
+  promote through a two-phase cluster epoch. Announced as
+  {"metric": "gateway_ready", ...}; POST /cluster/epoch is the
+  worker-side receiving end, and every worker response then carries
+  X-Cluster-Epoch.
 
     POST /predict        body = raw uint8 pixels, n*784 bytes ->
                          {"classes": [...], "n": n, "version": ...}
@@ -217,6 +226,16 @@ class ServerState:
         # with every NTP step — an uptime that moves backwards reads
         # as a restart that never happened.
         self._started_mono = time.monotonic()
+        # Cluster epoch (ISSUE 19): the fleet-wide version-visibility
+        # token a gateway assigns this worker. None on a standalone
+        # server (no epoch stamps); an integer once a gateway's
+        # fan-out lands. Mutated ONLY via apply_cluster_epoch — lint
+        # DML018 enforces the containment.
+        self._cluster_epoch = None
+
+    def cluster_epoch(self):
+        with self._lock:
+            return self._cluster_epoch
 
     def mark_running(self) -> None:
         """warming/failed -> running (no-op from draining)."""
@@ -283,7 +302,22 @@ class ServerState:
             "versions": len(desc["versions"]),
             "rollbacks": len(rollbacks),
             "last_rollback": attempts[-1] if attempts else None,
+            # Cluster epoch (ISSUE 19): None standalone, the gateway's
+            # fan-out value once this process serves as a fleet worker.
+            "cluster_epoch": self.cluster_epoch(),
         }
+        # Silicon provenance (ISSUE 19): a gateway bench has no
+        # in-process engine factory to ask, so the worker reports what
+        # it runs on — bench.py's cross-silicon baseline refusal reads
+        # these. getattr-safe: registry test doubles carry no factory.
+        factory = getattr(registry, "factory", None)
+        if factory is not None:
+            try:
+                payload["backend"] = factory.platform
+                payload["device_kind"] = str(
+                    factory.mesh.devices.flat[0].device_kind)
+            except Exception:
+                pass
         # Cascade state of the LIVE version (ISSUE 17): the calibrated
         # confidence threshold, cheap stage dtype and gate verdict —
         # None while warming or when no cascade is enabled. The fleet
@@ -328,6 +362,21 @@ def shed_retry_after_s(batcher, cap_s: float = 30.0) -> int:
     depth = batcher.inflight_batches()
     cap = max(1, int(cap_s))
     return max(1, min(cap, math.ceil(wait_s + (depth + 1) * svc_s)))
+
+
+def apply_cluster_epoch(state, cache, epoch: int) -> int:
+    """The worker-side receiving end of the gateway's cluster-epoch
+    fan-out — with Gateway.promote_fanout, the ONLY code allowed to
+    mutate the epoch (lint DML018: any other assignment could move a
+    worker's epoch outside the two-phase promote barrier and re-open
+    the mixed-version window). Aligns the prediction cache's
+    invalidation epoch in the same step, so entries computed under the
+    previous fleet version can never serve under the new one."""
+    with state._lock:
+        state._cluster_epoch = epoch
+    if cache is not None:
+        cache.align_epoch(epoch, reason=f"cluster epoch {epoch}")
+    return epoch
 
 
 def _selftest(batcher, metrics, n_requests: int, max_batch: int) -> dict:
@@ -534,6 +583,13 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 # serves
                 payload["tenancy"] = (scheduler.snapshot()
                                       if scheduler is not None else None)
+                # this process's XLA compile-event count (ISSUE 19): a
+                # gateway bench asserts recompiles_after_warmup == 0 on
+                # EVERY worker by steady-window deltas of this value —
+                # it has no in-process CompileCounter to read.
+                from distributedmnist_tpu.utils import CompileCounter
+                payload["compiles_total"] = (
+                    CompileCounter.instance().snapshot())
                 self._send(200, payload)
             elif self.path == "/models":
                 self._send(200, registry.describe())
@@ -559,6 +615,8 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 self._models_load()
             elif self.path == "/models/promote":
                 self._models_promote()
+            elif self.path == "/cluster/epoch":
+                self._cluster_epoch_admin()
             elif self.path.startswith("/replicas/"):
                 self._replicas_admin()
             elif self.path.startswith("/tenants/"):
@@ -651,6 +709,32 @@ def _http_serve(batcher, metrics, registry, state, port: int,
             except Exception as e:
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
+        # -- admin: cluster epoch (ISSUE 19) ---------------------------
+
+        def _cluster_epoch_admin(self):
+            """POST /cluster/epoch {"epoch": int} — a gateway's
+            promote fan-out landing on this worker. From here on every
+            /predict response is stamped X-Cluster-Epoch so the
+            gateway can reject any reply computed under a different
+            epoch than it admitted the request for; the prediction
+            cache's invalidation epoch aligns in the same step."""
+            try:
+                body = self._json_body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            epoch = body.get("epoch")
+            if (not isinstance(epoch, int) or isinstance(epoch, bool)
+                    or epoch < 0):
+                self._send(400, {"error": "'epoch' must be an integer "
+                                          f">= 0, got {epoch!r}"})
+                return
+            with admin_lock:
+                apply_cluster_epoch(state, cache, epoch)
+            self._send(200, {
+                "cluster_epoch": epoch,
+                "cache": cache.stats() if cache is not None else None})
+
         # -- admin: model lifecycle -----------------------------------
 
         def _models_load(self):
@@ -659,15 +743,36 @@ def _http_serve(batcher, metrics, registry, state, port: int,
             except (ValueError, json.JSONDecodeError) as e:
                 self._send(400, {"error": f"bad JSON body: {e}"})
                 return
+            # Fresh-init load (ISSUE 19): {"fresh": {"version"?,
+            # "seed"?}} registers + pre-warms a fresh-initialized
+            # version instead of a checkpoint restore — how a gateway
+            # bench stages a promotable second version on every worker
+            # of a fleet that shares no trained checkpoint.
+            fresh = body.get("fresh")
+            if fresh is not None and not isinstance(fresh, dict):
+                self._send(400, {"error": "'fresh' must be a JSON "
+                                          f"object, got {fresh!r}"})
+                return
+            if fresh is not None:
+                seed = fresh.get("seed", 0)
+                if not isinstance(seed, int) or isinstance(seed, bool):
+                    self._send(400, {"error": "'fresh.seed' must be an "
+                                              f"integer, got {seed!r}"})
+                    return
             try:
                 # Load + pre-warm runs on THIS handler thread — the
                 # dispatch thread keeps serving the live version
                 # throughout (warmup is off the hot path by
                 # construction).
                 with admin_lock:
-                    mv = registry.load_latest(
-                        directory=body.get("dir"),
-                        version=body.get("version"))
+                    if fresh is not None:
+                        mv = registry.add_fresh(
+                            version=fresh.get("version"),
+                            seed=fresh.get("seed", 0))
+                    else:
+                        mv = registry.load_latest(
+                            directory=body.get("dir"),
+                            version=body.get("version"))
                 self._send(200, mv.describe())
             except FileNotFoundError as e:
                 self._send(404, {"error": str(e)})
@@ -852,11 +957,21 @@ def _http_serve(batcher, metrics, registry, state, port: int,
                 the pipeline (ISSUE 9), plus an opt-in Server-Timing
                 stage breakdown (send `X-Server-Timing: 1`) — readable
                 because the batcher finishes a trace BEFORE resolving
-                its future."""
+                its future. Under a gateway (ISSUE 19) every response
+                also carries X-Cluster-Epoch (the mixed-epoch tripwire
+                reads it) and echoes the gateway's X-Gateway-Trace-Id
+                so the two processes' traces name each other."""
+                hdrs = {}
+                epoch = state.cluster_epoch()
+                if epoch is not None:
+                    hdrs["X-Cluster-Epoch"] = str(epoch)
+                gtid = self.headers.get("X-Gateway-Trace-Id")
+                if gtid:
+                    hdrs["X-Gateway-Trace-Id"] = gtid
                 tid = getattr(fut, "trace_id", None)
                 if tid is None:
-                    return {}
-                hdrs = {"X-Trace-Id": tid}
+                    return hdrs
+                hdrs["X-Trace-Id"] = tid
                 # explicit opt-IN only: "X-Server-Timing: 0" must not
                 # enable the breakdown just by being a truthy string
                 opt = (self.headers.get("X-Server-Timing") or "")
@@ -1075,6 +1190,23 @@ def main(argv=None) -> int:
         p.error("--port and --selftest are mutually exclusive")
     if args.request_timeout <= 0:
         p.error("--request-timeout must be > 0")
+    # Gateway mode (ISSUE 19): this process becomes the fleet front
+    # door — it spawns N full serve.py workers and routes, so the
+    # in-process single-server modes don't apply to it.
+    if args.gateway_workers is not None and args.gateway_workers < 1:
+        p.error("--gateway must be >= 1 workers")
+    if (args.gateway_worker_inflight is not None
+            and args.gateway_worker_inflight < 1):
+        p.error("--gateway-worker-inflight must be >= 1")
+    if args.gateway_vnodes is not None and args.gateway_vnodes < 1:
+        p.error("--gateway-vnodes must be >= 1")
+    if args.gateway_workers:
+        if args.selftest is not None:
+            p.error("--gateway serves HTTP; it does not compose with "
+                    "--selftest")
+        if args.port is None:
+            p.error("--gateway requires --port (0 = ephemeral, "
+                    "announced as gateway_ready on stdout)")
     if args.serve_max_inflight is not None and args.serve_max_inflight < 1:
         p.error("--serve-max-inflight must be >= 1")
     if args.serve_max_versions is not None and args.serve_max_versions < 2:
@@ -1159,6 +1291,28 @@ def main(argv=None) -> int:
         except ValueError as e:
             p.error(f"--serve-faults: {e}")
     cfg = config_lib.from_args(args)
+
+    # Gateway mode branches BEFORE any engine import or build: the
+    # gateway process routes HTTP and spawns workers — it must never
+    # initialize jax or hold device memory itself (the workers own
+    # the accelerators; the front door stays a cheap pure-Python
+    # process).
+    if cfg.gateway_workers:
+        from distributedmnist_tpu.serve.gateway import run_gateway
+        gw_args = argparse.Namespace(
+            gateway_workers=cfg.gateway_workers,
+            gateway_worker_inflight=cfg.gateway_worker_inflight,
+            gateway_vnodes=cfg.gateway_vnodes,
+            serve_cache=cfg.serve_cache,
+            serve_trace=cfg.serve_trace,
+            serve_trace_capacity=cfg.serve_trace_capacity,
+            serve_trace_sample=cfg.serve_trace_sample,
+            serve_slo_ms=cfg.serve_slo_ms,
+            seed=cfg.seed,
+            port=args.port,
+            metrics_every=args.metrics_every)
+        return run_gateway(
+            gw_args, list(sys.argv[1:] if argv is None else argv))
 
     from distributedmnist_tpu.serve import (DynamicBatcher, ServeMetrics,
                                             build_resilience,

@@ -104,6 +104,16 @@ def pytest_configure(config):
         "deterministic, runs in tier-1 under the serve sanitizer "
         "fixture — `-m trace` selects just this suite "
         "(scripts/tier1.sh notes the inclusion)")
+    config.addinivalue_line(
+        "markers",
+        "gateway: horizontal scale-out gateway test (serve/gateway.py: "
+        "consistent-hash ring determinism + minimal key movement, "
+        "affinity routing and backpressure, worker-death failover "
+        "ordering, the cluster-epoch two-phase promote and "
+        "mixed-epoch rejection, plus one multi-process HTTP "
+        "end-to-end); cheap and deterministic, runs in tier-1 under "
+        "the serve sanitizer fixture — `-m gateway` selects just "
+        "this suite (scripts/tier1.sh notes the inclusion)")
     # A DMNIST_SANITIZE=1 environment installs a process-global
     # sanitizer at import time — under pytest that instance must yield
     # to the per-test installs (the serve autouse fixture and the

@@ -810,6 +810,90 @@ def test_dml017_every_declared_attr_covered():
         assert _rules(src) == ["DML017"], attr
 
 
+# -- DML018: cluster-epoch promote-path containment (ISSUE 19) -------------
+
+
+def test_dml018_bare_epoch_assignment_flagged():
+    """Any assignment to a `_cluster_epoch` attribute outside the
+    allowed writers is a finding — a second epoch writer bypasses the
+    two-phase promote barrier."""
+    src = ("class G:\n"
+           "    def set_epoch(self, e):\n"
+           "        self._cluster_epoch = e\n")
+    assert _rules(src) == ["DML018"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert f.line == 3 and "promote_fanout" in f.message
+
+
+def test_dml018_augmented_and_annotated_assign_flagged():
+    aug = ("class G:\n"
+           "    def bump(self):\n"
+           "        self._cluster_epoch += 1\n")
+    assert _rules(aug) == ["DML018"]
+    ann = ("class G:\n"
+           "    def fix(self, e):\n"
+           "        self._cluster_epoch: int = e\n")
+    assert _rules(ann) == ["DML018"]
+
+
+def test_dml018_allowed_writers_clean():
+    """Construction, the gateway's promote flip, and the worker-side
+    receiving end are the ONLY legitimate epoch writers."""
+    for fn in ("__init__", "__post_init__", "promote_fanout",
+               "apply_cluster_epoch"):
+        src = (f"class G:\n"
+               f"    def {fn}(self):\n"
+               f"        self._cluster_epoch = 0\n")
+        assert _rules(src) == [], fn
+    # module-level helper spelling of the worker receiving end (the
+    # serve.py shape: apply_cluster_epoch(state, cache, epoch))
+    helper = ("def apply_cluster_epoch(state, cache, epoch):\n"
+              "    state._cluster_epoch = epoch\n")
+    assert _rules(helper) == []
+
+
+def test_dml018_nested_function_not_laundered():
+    """A closure nested inside an allowed writer is still that nested
+    function's own code path — the enclosing-name check uses the
+    INNERMOST function, so promote_fanout cannot launder a deferred
+    epoch write through a callback."""
+    src = ("class G:\n"
+           "    def promote_fanout(self):\n"
+           "        def later(e):\n"
+           "            self._cluster_epoch = e\n"
+           "        return later\n")
+    assert _rules(src) == ["DML018"]
+
+
+def test_dml018_module_level_and_scope():
+    """A module-level assignment is flagged; the rule applies to
+    serve/ and serve.py only (tests legitimately build gateway doubles
+    with epoch fields)."""
+    top = "class G:\n    pass\ng = G()\ng._cluster_epoch = 3\n"
+    assert _rules(top) == ["DML018"]
+    assert "module level" in lint.lint_source(top, SERVE_REL)[0].message
+    bare = ("class G:\n"
+            "    def poke(self, e):\n"
+            "        self._cluster_epoch = e\n")
+    assert _rules(bare, "serve.py") == ["DML018"]
+    for rel in ("tests/test_serve_gateway.py", "bench.py",
+                "distributedmnist_tpu/analysis/harnesses.py"):
+        assert _rules(bare, rel) == [], rel
+
+
+def test_dml018_real_promote_path_is_clean():
+    """The shipped gateway + worker epoch paths pass their own rule
+    (the repo-at-HEAD gate covers this too; asserting directly keeps
+    the failure local if either file grows a stray writer)."""
+    root = lint.repo_root()
+    for rel in ("distributedmnist_tpu/serve/gateway.py", "serve.py"):
+        with open(os.path.join(root, rel)) as fh:
+            src = fh.read()
+        found = [f.rule for f in lint.lint_source(src, rel)
+                 if f.rule == "DML018"]
+        assert found == [], rel
+
+
 # -- allowlist pragma ------------------------------------------------------
 
 

@@ -1,0 +1,608 @@
+"""Horizontal scale-out gateway tests (ISSUE 19, serve/gateway.py).
+
+Three layers, cheapest first:
+
+- HashRing unit tests: deterministic placement, MINIMAL key movement
+  on join/leave (the moved set asserted exactly, not just bounded),
+  failover order = ring order.
+- Gateway routing-core tests driven through in-memory fake transports
+  (no sockets): affinity pinning, least-loaded fallback, backpressure
+  that sheds instead of spilling, worker-death failover to the next
+  ring owner, mixed-epoch rejection, and the two-phase cluster-epoch
+  promote with mid-flip rollback.
+- ONE multi-process HTTP end-to-end: serve.py --gateway 2 with the
+  prediction cache on — pinning observed over real sockets, a
+  fleet-wide fresh-version promote bumping the cluster epoch, and a
+  worker SIGKILL surviving as a failover, with zero mixed-epoch
+  replies throughout.
+
+The fakes answer the worker admin surface the way serve.py does
+(epoch echo, healthz with live_version, promote flips the version) so
+the Gateway under test runs its real code paths end to end.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import gateway as gw_mod
+from distributedmnist_tpu.serve.cache import content_key
+from distributedmnist_tpu.serve.gateway import (Gateway, HashRing,
+                                                ring_key, worker_argv)
+from distributedmnist_tpu.serve.metrics import \
+    gateway_prometheus_exposition
+
+from conftest import worker_env
+
+pytestmark = pytest.mark.gateway
+
+
+def _keys(n, tag=b""):
+    return [hashlib.sha256(tag + str(i).encode()).digest()
+            for i in range(n)]
+
+
+# -- HashRing ---------------------------------------------------------------
+
+
+def test_ring_placement_deterministic():
+    """Placement is a pure function of the member set: two rings built
+    independently (different insertion order) agree on every key."""
+    a = HashRing(["w0", "w1", "w2", "w3"])
+    b = HashRing(["w3", "w1", "w0", "w2"])
+    for k in _keys(300):
+        assert a.owner(k) == b.owner(k)
+        assert a.owners(k) == b.owners(k)
+    assert a.members() == ["w0", "w1", "w2", "w3"]
+    # every key lands on a member
+    assert {a.owner(k) for k in _keys(300)} <= set(a.members())
+
+
+def test_ring_join_moves_only_keys_the_joiner_takes():
+    """Minimal movement, asserted exactly: adding a member re-maps a
+    key if and only if the NEW member now owns it — no key moves
+    between two pre-existing members."""
+    ring = HashRing(["w0", "w1", "w2", "w3"])
+    keys = _keys(1000)
+    before = {k: ring.owner(k) for k in keys}
+    ring.add("w4")
+    moved = {k for k in keys if ring.owner(k) != before[k]}
+    assert moved == {k for k in keys if ring.owner(k) == "w4"}
+    # and the moved fraction is consistent with ~1/5 ownership, not a
+    # rehash-everything (which would move ~4/5)
+    assert 0 < len(moved) / len(keys) < 0.45
+
+
+def test_ring_leave_moves_only_the_leavers_keys_to_successors():
+    """Removing a member re-maps exactly its own keys, and each moves
+    to its pre-departure failover successor (owners()[1] filtered to
+    survivors) — the property that makes death-failover and key
+    migration land on the SAME worker."""
+    ring = HashRing(["w0", "w1", "w2", "w3"])
+    keys = _keys(1000)
+    before = {k: ring.owners(k) for k in keys}
+    ring.remove("w2")
+    for k in keys:
+        old = before[k]
+        if old[0] != "w2":
+            assert ring.owner(k) == old[0], "survivor's key moved"
+        else:
+            assert ring.owner(k) == old[1], (
+                "leaver's key must move to its next ring owner")
+
+
+def test_ring_owners_is_the_failover_order():
+    ring = HashRing(["w0", "w1", "w2", "w3"])
+    for k in _keys(100):
+        order = ring.owners(k)
+        assert order[0] == ring.owner(k)
+        assert sorted(order) == ring.members()      # all distinct
+        assert ring.owners(k, n=2) == order[:2]     # prefix property
+
+
+def test_ring_api_errors_and_empty():
+    ring = HashRing(["w0"])
+    with pytest.raises(ValueError):
+        ring.add("w0")
+    with pytest.raises(KeyError):
+        ring.remove("nope")
+    with pytest.raises(ValueError):
+        HashRing(vnodes=0)
+    ring.remove("w0")
+    assert ring.owners(b"k") == [] and ring.owner(b"k") is None
+    assert len(ring) == 0 and "w0" not in ring
+
+
+def test_ring_key_is_the_cache_identity():
+    """The ring hashes exactly the tuple the PR 10 cache keys entries
+    by — the sharding argument rests on the identities being equal."""
+    x = (np.arange(2 * 784, dtype=np.int64) % 251).astype(
+        np.uint8).reshape(2, 28, 28, 1)              # two 784-byte rows
+    body = x.tobytes()
+    ck = content_key("v1", "float32", x)
+    assert ck == ("v1", "float32", 2, hashlib.sha256(body).digest())
+    base = ring_key(*ck)
+    assert ring_key(*content_key("v1", "float32", x)) == base
+    assert ring_key(*content_key("v2", "float32", x)) != base
+    assert ring_key(*content_key("v1", "int8", x)) != base
+    other = np.zeros((2, 28, 28, 1), np.uint8)
+    assert ring_key(*content_key("v1", "float32", other)) != base
+
+
+# -- Gateway core over fake transports --------------------------------------
+
+
+ROW = bytes(784)
+
+
+class FakeWorker:
+    """In-memory worker transport: answers the serve.py admin surface
+    (epoch echo, healthz, load/promote) and stamps /predict replies
+    with its current cluster epoch, like a real worker. Scriptable
+    failure knobs drive the death/rollback paths."""
+
+    def __init__(self, rid):
+        self.rid = rid
+        self.calls = []               # (method, path, parsed-or-None)
+        self.epoch = None
+        self.live_version = "v1"
+        self.live_dtype = "float32"
+        self.fail_predict = None      # exception to raise on /predict
+        self.predict_hook = None      # callable(body, headers) -> tuple
+        self.fail_promote = False
+
+    def request(self, method, path, body=None, headers=None,
+                timeout_s=None):
+        parsed = None
+        if method == "POST" and path != "/predict" and body:
+            parsed = json.loads(body)
+        self.calls.append((method, path, parsed))
+        if path == "/cluster/epoch":
+            self.epoch = parsed["epoch"]
+            return 200, {}, json.dumps(
+                {"cluster_epoch": self.epoch}).encode()
+        if path == "/healthz":
+            return 200, {}, json.dumps(
+                {"ok": True, "live_version": self.live_version,
+                 "live_infer_dtype": self.live_dtype,
+                 "cluster_epoch": self.epoch}).encode()
+        if path == "/predict":
+            if self.fail_predict is not None:
+                raise self.fail_predict
+            if self.predict_hook is not None:
+                return self.predict_hook(body, headers)
+            hdrs = {"X-Cluster-Epoch": str(self.epoch or 0)}
+            return 200, hdrs, json.dumps(
+                {"worker": self.rid}).encode()
+        if path == "/models/load":
+            return 200, {}, json.dumps({"version": "v2"}).encode()
+        if path == "/models/promote":
+            if self.fail_promote:
+                return 500, {}, json.dumps(
+                    {"error": "injected promote failure"}).encode()
+            self.live_version = parsed["version"]
+            return 200, {}, json.dumps(
+                {"live": self.live_version}).encode()
+        raise AssertionError(f"unexpected {method} {path}")
+
+    def predicts(self):
+        return [c for c in self.calls if c[1] == "/predict"]
+
+    def close(self):
+        pass
+
+
+def make_gateway(n=3, **kw):
+    fakes = {f"w{i}": FakeWorker(f"w{i}") for i in range(n)}
+    workers = [gw_mod._Worker(rid=rid, port=9000 + i, transport=t)
+               for i, (rid, t) in enumerate(fakes.items())]
+    gw = Gateway(workers, **kw)
+    gw.start()
+    return gw, fakes
+
+
+def _key_for(gw, body):
+    return ring_key("v1", "float32", len(body) // 784,
+                    hashlib.sha256(body).digest())
+
+
+def test_affinity_pins_each_key_to_its_ring_owner():
+    gw, fakes = make_gateway()
+    for i in range(4):
+        body = bytes([i]) * 784
+        expect = gw.ring.owner(_key_for(gw, body))
+        picked = set()
+        for _ in range(5):
+            status, hdrs, rbody = gw.handle_predict(body, {})
+            assert status == 200, rbody
+            picked.add(hdrs["X-Gateway-Worker"])
+        assert picked == {expect}, (
+            "a hot key must pin to exactly its ring owner")
+    snap = gw.snapshot()
+    assert snap["routed_affinity"] == 20
+    assert snap["routed_balanced"] == 0
+    assert snap["mixed_epoch_rejected"] == 0
+
+
+def test_balanced_fallback_when_uncached():
+    """affinity off (fleet runs uncached) -> every request takes the
+    fleet's least-loaded pick, which spreads identical bodies across
+    workers instead of pinning."""
+    gw, fakes = make_gateway(affinity=False)
+    picked = set()
+    for _ in range(6):
+        status, hdrs, _ = gw.handle_predict(ROW, {})
+        assert status == 200
+        picked.add(hdrs["X-Gateway-Worker"])
+    assert len(picked) == 3, "least-loaded + LRU tiebreak must rotate"
+    snap = gw.snapshot()
+    assert snap["routed_balanced"] == 6 and snap["routed_affinity"] == 0
+
+
+def test_backpressure_sheds_instead_of_spilling():
+    """A saturated ring owner is a 503 — dispatching the key anywhere
+    else would compute AND cache it on a non-owner (a duplicate entry
+    by construction)."""
+    gw, fakes = make_gateway(worker_inflight=2)
+    body = b"\x07" * 784
+    owner = gw.ring.owner(_key_for(gw, body))
+    with gw._cond:
+        gw._workers[owner].inflight = 2      # window full
+    status, hdrs, rbody = gw.handle_predict(body, {})
+    assert status == 503
+    assert json.loads(rbody)["reason"] == "backpressure"
+    assert hdrs["Retry-After"] == "1"
+    assert all(not f.predicts() for f in fakes.values()), (
+        "backpressure must never spill the key to a sibling")
+    assert gw.snapshot()["backpressure_503"] == 1
+    with gw._cond:
+        gw._workers[owner].inflight = 0
+    status, hdrs, _ = gw.handle_predict(body, {})
+    assert status == 200 and hdrs["X-Gateway-Worker"] == owner
+
+
+def test_worker_death_fails_over_to_next_ring_owner():
+    gw, fakes = make_gateway()
+    body = b"\x11" * 784
+    order = gw.ring.owners(_key_for(gw, body))
+    fakes[order[0]].fail_predict = ConnectionRefusedError("refused")
+    status, hdrs, rbody = gw.handle_predict(body, {})
+    assert status == 200, rbody
+    assert hdrs["X-Gateway-Worker"] == order[1], (
+        "failover must go to the NEXT owner in ring order")
+    snap = gw.snapshot()
+    assert snap["worker_deaths"] == 1
+    assert snap["failovers"] == 1 and snap["failover_rescued"] == 1
+    # the dead worker left the ring, so the key MIGRATED to exactly
+    # the worker that rescued it — no second failover needed
+    assert order[0] not in gw.ring
+    assert gw.ring.owner(_key_for(gw, body)) == order[1]
+    status, hdrs, _ = gw.handle_predict(body, {})
+    assert status == 200 and hdrs["X-Gateway-Worker"] == order[1]
+    assert gw.snapshot()["failovers"] == 1, "no failover on the retry"
+    # in-flight accounting drained on both the failed and rescue paths
+    with gw._cond:
+        assert all(w.inflight == 0 for w in gw._workers.values())
+
+
+def test_failover_is_tried_exactly_once():
+    """Owner dead AND its successor dead -> 502, not a walk of the
+    whole ring (the ISSUE contract: ONE redispatch)."""
+    gw, fakes = make_gateway()
+    body = b"\x13" * 784
+    order = gw.ring.owners(_key_for(gw, body))
+    fakes[order[0]].fail_predict = ConnectionRefusedError("a")
+    fakes[order[1]].fail_predict = ConnectionRefusedError("b")
+    status, _, rbody = gw.handle_predict(body, {})
+    assert status == 502
+    assert "also failed" in json.loads(rbody)["error"]
+    assert not fakes[order[2]].predicts(), (
+        "the third owner must NOT be tried — one failover only")
+    assert gw.snapshot()["worker_deaths"] == 2
+
+
+def test_all_workers_dead_is_shed_not_crash():
+    gw, fakes = make_gateway(n=2)
+    for w in list(gw._workers.values()):
+        gw._mark_dead(w)
+    status, _, rbody = gw.handle_predict(ROW, {})
+    assert status == 503
+    assert json.loads(rbody)["reason"] == "no_workers"
+    code, payload = gw.healthz()
+    assert code == 503 and payload["ok"] is False
+
+
+def test_mixed_epoch_reply_rejected():
+    """A reply stamped with a different epoch than the request was
+    admitted under must never reach the client (503 + counter) — the
+    tripwire behind the bench's zero-mixed-epoch assertion."""
+    gw, fakes = make_gateway()
+    body = b"\x21" * 784
+    owner = gw.ring.owner(_key_for(gw, body))
+    fakes[owner].predict_hook = lambda b, h: (
+        200, {"X-Cluster-Epoch": "7"}, b'{"worker": "liar"}')
+    status, hdrs, rbody = gw.handle_predict(body, {})
+    assert status == 503
+    assert json.loads(rbody)["reason"] == "mixed_epoch"
+    assert gw.snapshot()["mixed_epoch_rejected"] == 1
+    # non-200s (e.g. a worker 429/504 verdict) are NOT epoch-checked:
+    # sheds carry no payload a client could mix
+    fakes[owner].predict_hook = lambda b, h: (
+        429, {"X-Cluster-Epoch": "7"}, b'{"error": "quota"}')
+    status, _, _ = gw.handle_predict(body, {})
+    assert status == 429
+    assert gw.snapshot()["mixed_epoch_rejected"] == 1
+
+
+def test_promote_fanout_two_phase_bumps_cluster_epoch():
+    gw, fakes = make_gateway()
+    status, _, _ = gw.handle_predict(ROW, {})
+    assert status == 200
+    code, payload = gw.promote_fanout(load={"fresh": {"seed": 1}})
+    assert code == 200, payload
+    assert payload == {"promoted": "v2", "cluster_epoch": 1,
+                       "workers": ["w0", "w1", "w2"]}
+    snap = gw.snapshot()
+    assert snap["cluster_epoch"] == 1 and snap["promotes"] == 1
+    assert snap["live_version"] == "v2" and snap["paused"] is False
+    for f in fakes.values():
+        paths = [c[1] for c in f.calls]
+        # two-phase order on every worker: prepare, then flip, then
+        # the epoch fan-out (the initial epoch-0 seed came first)
+        il, ip, ie = (paths.index("/models/load"),
+                      paths.index("/models/promote"),
+                      len(paths) - 1 - paths[::-1].index("/cluster/epoch"))
+        assert il < ip < ie
+        assert f.epoch == 1 and f.live_version == "v2"
+    # post-promote traffic is admitted AND answered under epoch 1 —
+    # nothing mixes
+    status, hdrs, _ = gw.handle_predict(ROW, {})
+    assert status == 200 and hdrs["X-Cluster-Epoch"] == "1"
+    assert gw.snapshot()["mixed_epoch_rejected"] == 0
+
+
+def test_promote_midflip_failure_rolls_back():
+    gw, fakes = make_gateway()
+    fakes["w1"].fail_promote = True
+    code, payload = gw.promote_fanout(load={})
+    assert code == 409
+    assert "rolled back" in payload["error"]
+    snap = gw.snapshot()
+    assert snap["cluster_epoch"] == 0, "a failed flip must not bump"
+    assert snap["live_version"] == "v1" and snap["paused"] is False
+    # w0 flipped first, then rolled back to the old version
+    w0_promotes = [c[2] for c in fakes["w0"].calls
+                   if c[1] == "/models/promote"]
+    assert [p["version"] for p in w0_promotes] == ["v2", "v1"]
+    assert fakes["w0"].live_version == "v1"
+    status, _, _ = gw.handle_predict(ROW, {})
+    assert status == 200, "traffic resumes after the rollback"
+
+
+def test_promote_pause_sheds_after_bounded_wait():
+    gw, fakes = make_gateway()
+    gw.pause_wait_s = 0.05
+    with gw._cond:
+        gw._paused = True
+    t0 = time.monotonic()
+    status, _, rbody = gw.handle_predict(ROW, {})
+    assert status == 503
+    assert json.loads(rbody)["reason"] == "promote_pause"
+    assert time.monotonic() - t0 < 5.0
+    assert gw.snapshot()["paused_503"] == 1
+    with gw._cond:
+        gw._paused = False
+        gw._cond.notify_all()
+    status, _, _ = gw.handle_predict(ROW, {})
+    assert status == 200
+
+
+def test_tenant_headers_forward_and_surface():
+    """ISSUE 18 composition: tenant/SLO headers reach the worker
+    untouched (its scheduler sees what the client sent), worker
+    verdict headers surface back; unrelated headers do neither."""
+    gw, fakes = make_gateway(n=1)
+    seen = {}
+
+    def hook(body, headers):
+        seen.update(headers)
+        return 200, {"X-Cluster-Epoch": "0", "X-Trace-Id": "t-123",
+                     "Retry-After": "9", "X-Secret": "no"}, b"{}"
+
+    fakes["w0"].predict_hook = hook
+    status, hdrs, _ = gw.handle_predict(
+        ROW, {"X-Tenant": "free", "X-Deadline-Ms": "50",
+              "X-Accuracy-Class": "exact", "X-Nope": "drop-me"})
+    assert status == 200
+    assert seen["X-Tenant"] == "free"
+    assert seen["X-Deadline-Ms"] == "50"
+    assert seen["X-Accuracy-Class"] == "exact"
+    assert "X-Nope" not in seen
+    assert hdrs["X-Trace-Id"] == "t-123"
+    assert hdrs["Retry-After"] == "9"
+    assert hdrs["X-Gateway-Worker"] == "w0"
+    assert "X-Secret" not in hdrs
+
+
+def test_bad_body_is_400_without_dispatch():
+    gw, fakes = make_gateway(n=1)
+    for body in (b"", b"x" * 783):
+        status, _, rbody = gw.handle_predict(body, {})
+        assert status == 400
+        assert "784" in json.loads(rbody)["error"]
+    assert not fakes["w0"].predicts()
+
+
+def test_worker_argv_strips_gateway_flags():
+    argv = ["--model", "mlp", "--gateway", "2", "--serve-cache",
+            "--gateway-vnodes=32", "--gateway-worker-inflight", "4",
+            "--port", "7000", "--serve-max-batch", "16"]
+    assert worker_argv(argv) == [
+        "--model", "mlp", "--serve-cache", "--serve-max-batch", "16",
+        "--port", "0"]
+
+
+def test_gateway_prometheus_exposition():
+    gw, fakes = make_gateway()
+    for i in range(3):
+        gw.handle_predict(bytes([i]) * 784, {})
+    text = gateway_prometheus_exposition(gw.snapshot())
+    assert "# HELP dmnist_gateway_requests_total" in text
+    assert "dmnist_gateway_requests_total 3" in text
+    assert "dmnist_gateway_cluster_epoch 0" in text
+    assert "dmnist_gateway_workers 3" in text
+    assert 'dmnist_gateway_worker_inflight{worker="w0"} 0' in text
+    for line in text.splitlines():
+        assert line.startswith(("#", "dmnist_gateway_")), line
+
+
+# -- end-to-end over real processes ----------------------------------------
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_json(url, payload, timeout=600):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _predict(base, body, timeout=75):
+    req = urllib.request.Request(
+        f"{base}/predict", data=body,
+        headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def test_gateway_http_end_to_end():
+    """serve.py --gateway 2 over real sockets: both workers warm, hot
+    keys pin over HTTP, a fleet-wide fresh-version promote bumps the
+    cluster epoch with zero mixed-epoch replies, and a SIGKILLed
+    worker surfaces as failover rescues, not client errors."""
+    env, repo = worker_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "serve.py"),
+         "--model", "mlp", "--device", "cpu", "--serve-max-batch", "16",
+         "--serve-cache", "--gateway", "2", "--port", "0",
+         "--metrics-every", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=repo)
+    try:
+        port = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, "gateway exited before announcing readiness"
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "gateway_ready":
+                port = rec["port"]
+                assert rec["workers"] == 2
+                assert len(rec["worker_ports"]) == 2
+                break
+        assert port is not None, "no gateway_ready line"
+        base = f"http://127.0.0.1:{port}"
+
+        # every worker warm (gateway /healthz aggregates worker rows)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                payload = _get(f"{base}/healthz")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                payload = json.loads(e.read())
+            if payload["ok"] and all(
+                    r.get("ok") for r in payload["workers"]):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"fleet never became healthy: {payload}")
+        assert payload["cluster_epoch"] == 0
+        assert all(r["cluster_epoch"] == 0 for r in payload["workers"])
+
+        # hot keys pin: each body repeats onto ONE worker, via sockets
+        pin = {}
+        for i in range(6):
+            body = bytes([i]) * 784
+            owners = set()
+            for _ in range(3):
+                status, hdrs, out = _predict(base, body)
+                assert status == 200, out
+                assert hdrs["X-Cluster-Epoch"] == "0"
+                assert len(out["classes"]) == out["n"] == 1
+                owners.add(hdrs["X-Gateway-Worker"])
+            assert len(owners) == 1, "hot key bounced between workers"
+            pin[i] = owners.pop()
+        assert len(set(pin.values())) == 2, (
+            "6 distinct keys should shard across both workers "
+            f"(got {pin})")
+
+        # fleet-wide promote of a fresh version: cluster epoch 0 -> 1,
+        # stamped on every subsequent reply, no mixed-epoch rejects
+        out = _post_json(f"{base}/models/promote",
+                         {"load": {"fresh": {"seed": 3}}})
+        assert out["cluster_epoch"] == 1, out
+        v2 = out["promoted"]
+        payload = _get(f"{base}/healthz")
+        assert payload["cluster_epoch"] == 1
+        assert all(r["cluster_epoch"] == 1 and r["live_version"] == v2
+                   for r in payload["workers"])
+        status, hdrs, _ = _predict(base, bytes([1]) * 784)
+        assert status == 200 and hdrs["X-Cluster-Epoch"] == "1"
+
+        # kill one worker outright: distinct keys keep answering 200
+        # (the one that routed to the corpse comes back as a rescue)
+        os.kill(_gateway_children(proc.pid)[0], signal.SIGKILL)
+        for i in range(10, 30):
+            status, hdrs, out = _predict(base, bytes([i]) * 784)
+            assert status == 200, (i, out)
+        snap = _get(f"{base}/metrics")
+        assert snap["worker_deaths"] == 1, snap
+        assert snap["failover_rescued"] == snap["failovers"] >= 1
+        assert snap["workers_active"] == 1
+        assert snap["mixed_epoch_rejected"] == 0
+        prom = urllib.request.urlopen(
+            f"{base}/metrics?format=prometheus", timeout=10).read()
+        assert b"dmnist_gateway_worker_deaths_total 1" in prom
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _gateway_children(gateway_pid):
+    """The worker pids: direct children of the gateway process, read
+    from /proc (Linux; field 4 of /proc/<pid>/stat is the ppid)."""
+    kids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                if f.read().rsplit(")", 1)[1].split()[1] == \
+                        str(gateway_pid):
+                    kids.append(int(pid))
+        except OSError:
+            continue
+    assert kids, f"gateway {gateway_pid} has no child workers"
+    return sorted(kids)

@@ -1,0 +1,128 @@
+"""A TRAINED checkpoint through the serving parity gate (ISSUE 19
+satellite, closing the ROADMAP follow-up).
+
+Every serving test so far boots from fresh-init params or a
+synthetically "trained" state assembled in-process. This is the CI
+proof for the real production path: train.py commits an orbax
+checkpoint with actually-descended params, then serve.py boots a
+worker FROM that checkpoint with a low-precision serving dtype — so
+the registry's full boot chain runs against trained weights:
+params-only restore, per-bucket warmup, the bf16 accuracy-parity gate
+measured against the trained f32 reference (PARITY_GATES thresholds,
+not a fresh-init logit field that any quantization trivially matches),
+and the atomic promote to live.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from conftest import committed_steps, worker_env
+
+
+def test_trained_checkpoint_serves_through_parity_gate(tmp_path):
+    ckpt = str(tmp_path / "trained")
+    env, repo = worker_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+    # 1) real training run, to completion: 300 SGD steps on the
+    # synthetic stream, periodic saves committing at least one step.
+    # The step count is load-bearing for the parity gate downstream: a
+    # barely-trained model has near-uniform logits, so bf16 rounding
+    # flips argmax rows and the gate (argmax agreement >= 0.995)
+    # correctly REFUSES the variant. Descending to confident logits is
+    # exactly what makes low-precision serving safe.
+    train = subprocess.run(
+        [sys.executable, os.path.join(repo, "train.py"),
+         "--device", "cpu", "--num-devices", "8", "--synthetic",
+         "--model", "mlp", "--optimizer", "sgd",
+         "--learning-rate", "0.1", "--batch-size", "64",
+         "--steps", "300", "--eval-every", "1000000", "--log-every", "0",
+         "--checkpoint-dir", ckpt, "--checkpoint-every", "100"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo)
+    assert train.returncode == 0, train.stdout[-3000:] + train.stderr[-2000:]
+    steps = committed_steps(ckpt)
+    assert steps, "training committed no checkpoint"
+
+    # 2) boot a serving worker FROM the checkpoint, bf16 live: the
+    # parity gate must measure the quantized forward against the
+    # trained f32 reference before any traffic lands on it
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "serve.py"),
+         "--model", "mlp", "--device", "cpu", "--serve-max-batch", "16",
+         "--checkpoint-dir", ckpt, "--serve-infer-dtype", "bfloat16",
+         "--port", "0", "--metrics-every", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=repo)
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, "serve.py exited before announcing readiness"
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "serve_ready":
+                port = rec["port"]
+                break
+        assert port is not None, "no serve_ready line"
+        base = f"http://127.0.0.1:{port}"
+
+        # healthy flips when the f32 reference goes live; the gated
+        # bf16 activation lands right after — poll for BOTH
+        deadline = time.monotonic() + 300
+        payload = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(f"{base}/healthz",
+                                            timeout=10) as r:
+                    payload = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                assert e.code == 503, e.code
+                payload = json.loads(e.read())
+            if payload["ok"] and \
+                    payload["live_infer_dtype"] == "bfloat16":
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("worker never served bf16 from the trained "
+                        f"checkpoint: {payload}")
+        live = payload["live_version"]
+
+        with urllib.request.urlopen(f"{base}/models", timeout=10) as r:
+            models = json.loads(r.read())
+        mv = next(v for v in models["versions"] if v["version"] == live)
+        # the live version IS the trained checkpoint, not fresh-init
+        assert mv["source"] == f"checkpoint {ckpt}", mv["source"]
+        assert mv["step"] in steps, (mv["step"], steps)
+        # ...and the bf16 variant went live only THROUGH the parity
+        # gate: the measured record is attached, and it passed against
+        # the trained reference
+        var = mv["variants"]["bfloat16"]
+        assert var["state"] == "ready", var
+        parity = var["parity"]
+        assert parity is not None and parity["passed"] is True, parity
+        assert parity["argmax_agreement"] >= 0.995, parity
+
+        # trained params answer traffic end to end
+        req = urllib.request.Request(
+            f"{base}/predict", data=bytes(784),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=75) as r:
+            out = json.loads(r.read())
+        assert out["n"] == 1 and out["version"] == live
+        assert 0 <= out["classes"][0] <= 9
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
