@@ -260,6 +260,26 @@ def main(argv=None) -> int:
                         "dispatch-share/weight-share fairness ratios "
                         "(bar: within [0.8, 1.25]), and the recompile "
                         "count (must stay 0 across both phases)")
+    p.add_argument("--trace-replay", default=None, metavar="SPEC",
+                   help="[serve] add the workload-realism leg "
+                        "(ISSUE 20): replay a seeded deterministic "
+                        "arrival trace (serve/workload.py spec string, "
+                        "e.g. 'square:qps=60,burst=4,period=2,"
+                        "duration=4') open-loop against a static "
+                        "floor-provisioned config, reporting SLO "
+                        "attainment and chip-seconds per million "
+                        "served requests; with --autoscale the SAME "
+                        "schedule replays again under the closed-loop "
+                        "autoscaler and the record carries both phases "
+                        "plus the scale-action log and flap audit")
+    p.add_argument("--autoscale", action="store_true", default=None,
+                   help="[serve] run the --trace-replay leg's second "
+                        "phase under the closed-loop autoscaler "
+                        "(serve/autoscale.py window actuator): "
+                        "hysteresis + cooldown control over the live "
+                        "saturation surface, scale moving only along "
+                        "the pre-warmed bucket ladder (the recompile "
+                        "bar still applies)")
     p.add_argument("--baseline", default=None, metavar="BENCH_serve.json",
                    help="[serve] a prior BENCH_serve_r*.json to diff "
                         "against: prints a delta table and REFUSES "
@@ -346,6 +366,8 @@ def main(argv=None) -> int:
                    "--dtype-sweep": args.dtype_sweep,
                    "--cascade": args.cascade,
                    "--multimodel": args.multimodel,
+                   "--trace-replay": args.trace_replay,
+                   "--autoscale": args.autoscale,
                    "--baseline": args.baseline,
                    "--chaos": args.chaos,
                    "--trace": args.trace,
@@ -402,6 +424,19 @@ def main(argv=None) -> int:
         if args.zipf_cache_off and not args.zipf:
             p.error("--zipf-cache-off modifies the --zipf leg; pass "
                     "--zipf too")
+        if args.autoscale and not args.trace_replay:
+            p.error("--autoscale modifies the --trace-replay leg; pass "
+                    "--trace-replay too (the autoscaler is only "
+                    "measurable against a changing arrival rate)")
+        if args.trace_replay is not None:
+            # A malformed trace spec is a usage error NOW (exit 2) —
+            # it must never replay *something else* minutes into a run.
+            from distributedmnist_tpu.serve.workload import (
+                parse_trace_spec)
+            try:
+                parse_trace_spec(args.trace_replay)
+            except ValueError as e:
+                p.error(f"--trace-replay: {e}")
         if args.serve_cache and not args.chaos:
             p.error("--serve-cache wires the cache front into the "
                     "--chaos drill (the hot-key cache leg is --zipf); "
@@ -442,7 +477,9 @@ def main(argv=None) -> int:
                               ("--swap-during-load",
                                args.swap_during_load),
                               ("--serve-cache", args.serve_cache),
-                              ("--serve-hedge", args.serve_hedge)):
+                              ("--serve-hedge", args.serve_hedge),
+                              ("--trace-replay", args.trace_replay),
+                              ("--autoscale", args.autoscale)):
                 if val:
                     p.error(f"{flag} is an in-process serve leg; the "
                             "--gateway fleet bench has its own "
@@ -2263,6 +2300,272 @@ def _serve_trace_leg(router, metrics, factory, make_batcher,
     }
 
 
+def _serve_trace_replay_leg(router, metrics, factory, make_batcher,
+                            spec: str, seed: int, autoscale: bool,
+                            slo_ms: float, chaos: bool = False) -> dict:
+    """The workload-realism leg (ISSUE 20): replay ONE seeded
+    deterministic arrival schedule (serve/workload.py) open-loop
+    against a static floor-provisioned config and — with --autoscale —
+    again under the closed-loop autoscaler, on the identical schedule
+    (same seed, byte-identical arrivals, byte-identical request
+    content per key). Headlines: SLO attainment (within-SLO
+    completions over ALL arrivals — sheds are misses) and chip-seconds
+    per million within-SLO requests, the autoscaler's spend integrated
+    from its own action log so the artifact's cost claim is auditable.
+
+    The static phase is trough-provisioned on purpose (window =
+    floor, bucket ceiling = the smallest bucket covering the trace's
+    largest request): the autoscaler's job is exactly to buy burst
+    capacity that static trough provisioning lacks and give it back in
+    the quiet phases. Scale moves only along the engine's pre-warmed
+    bucket ladder, so the whole-run recompiles_after_warmup==0 bar
+    covers this leg too. Zero flaps holds by construction (any action
+    inside the cooldown window is suppressed, so consecutive actions
+    are always >= cooldown_s apart) and is still AUDITED from the
+    action log, not asserted."""
+    import hashlib
+
+    import numpy as np
+
+    from distributedmnist_tpu.serve import Rejected, workload
+    from distributedmnist_tpu.serve.autoscale import (Autoscaler,
+                                                      WindowActuator,
+                                                      batcher_signals)
+
+    legs = workload.parse_trace_spec(spec)
+    events = workload.materialize(legs, seed)
+    dur = workload.total_duration(legs)
+    if not events:
+        raise RuntimeError(f"trace spec {spec!r} with seed {seed} "
+                           "materialized zero arrivals")
+    # (key, rows) -> byte-stable request content: the cache/dedup
+    # identity follows the trace's key mix exactly, and a regression
+    # run from the recorded seed replays the same bytes.
+    pool: dict = {}
+    for e in events:
+        k = (e.key, e.rows)
+        if k not in pool:
+            r = np.random.default_rng([seed, e.key, e.rows])
+            pool[k] = r.integers(0, 256, (e.rows, 28, 28, 1),
+                                 dtype=np.uint8)
+    buckets = list(factory.buckets)
+    max_rows = max(e.rows for e in events)
+    base_idx = next((i for i, b in enumerate(buckets) if b >= max_rows),
+                    len(buckets) - 1)
+    base_max_batch = buckets[base_idx]
+    floor = 1
+    # ceiling: one window unit per remaining bucket rung (capped) so
+    # every grow step buys a real capacity rung
+    ceiling = max(floor + 1,
+                  min(8, floor + (len(buckets) - 1 - base_idx)))
+
+    def replay(batcher) -> dict:
+        done: list = []             # (latency_s, errored) per completion
+        sheds = 0
+        lag_max = 0.0
+        t0 = time.monotonic()
+        for e in events:
+            target = t0 + e.t
+            now = time.monotonic()
+            if target > now:
+                time.sleep(target - now)
+            else:
+                lag_max = max(lag_max, now - target)
+            try:
+                fut = batcher.submit(pool[(e.key, e.rows)])
+            except Rejected:
+                sheds += 1
+                continue
+            ts = time.monotonic()
+            fut.add_done_callback(
+                lambda f, ts=ts: done.append(
+                    (time.monotonic() - ts,
+                     f.exception() is not None)))
+        _drain_or_die(batcher, timeout=120)
+        total = len(events)
+        served = sum(1 for _, err in done if not err)
+        within = sum(1 for lat, err in done
+                     if not err and lat * 1e3 <= slo_ms)
+        lats = sorted(lat * 1e3 for lat, err in done if not err)
+
+        def q(p: float):
+            return (round(lats[min(len(lats) - 1, int(p * len(lats)))],
+                          2) if lats else None)
+
+        return {"arrivals": total, "served": served,
+                "shed": sheds + sum(1 for _, err in done if err),
+                "within_slo": within,
+                "slo_attainment": round(within / total, 4),
+                "latency_ms": {"p50": q(0.50), "p90": q(0.90),
+                               "p99": q(0.99)},
+                "max_submit_lag_ms": round(lag_max * 1e3, 2)}
+
+    def per_m(chip_s: float, within: int):
+        # chip-seconds per million WITHIN-SLO requests: spend over
+        # goodput, not over arrivals — capacity that missed the SLO
+        # earns nothing
+        return (round(chip_s / within * 1e6, 1) if within else None)
+
+    leg = {
+        "spec": spec, "seed": seed,
+        "autoscale_enabled": bool(autoscale),
+        "slo_ms": slo_ms,
+        "legs": workload.describe(legs),
+        "events": len(events),
+        "duration_s": round(dur, 3),
+        # the replay-determinism receipt: rerunning this spec+seed
+        # must materialize a schedule hashing to exactly this
+        "schedule_sha256": hashlib.sha256(
+            workload.schedule_bytes(events)).hexdigest(),
+        "floor_units": floor, "ceiling_units": ceiling,
+        "base_max_batch": base_max_batch,
+    }
+
+    _mark(f"trace replay [static floor={floor}, "
+          f"max_batch={base_max_batch}]: {len(events)} arrivals over "
+          f"{dur:.1f}s ({spec})")
+    metrics.reset()
+    b = make_batcher(floor, max_batch=base_max_batch)
+    try:
+        static = replay(b)
+    finally:
+        b.stop()
+    static["units"] = floor
+    static["chip_seconds"] = round(floor * dur, 3)
+    static["chip_seconds_per_m_requests"] = per_m(
+        static["chip_seconds"], static["within_slo"])
+    leg["static"] = static
+    _mark(f"trace replay [static]: attainment "
+          f"{static['slo_attainment']:.3f}, "
+          f"{static['shed']} shed, p99 {static['latency_ms']['p99']} "
+          f"ms, {static['chip_seconds']} chip-s")
+
+    autoscaled = None
+    if autoscale:
+        _mark(f"trace replay [autoscaled {floor}..{ceiling}]: same "
+              "schedule under the closed-loop controller")
+        metrics.reset()
+        # construction-time window = ceiling (the parked-permit
+        # design: the actuator narrows by parking permits, so the
+        # semaphore itself never resizes); then start at the SAME
+        # trough provisioning the static phase ran
+        b = make_batcher(ceiling, max_batch=base_max_batch)
+        actuator = WindowActuator(b, floor=floor, ceiling=ceiling,
+                                  base_max_batch=base_max_batch)
+        actuator.scale_to(floor)
+        ctl = Autoscaler(
+            actuator,
+            batcher_signals(b, metrics=metrics, slo_ms=slo_ms),
+            high=0.6, low=0.15,
+            cooldown_s=max(0.3, dur / 24), interval_s=0.05,
+            metrics=metrics)
+        ctl.start()
+        try:
+            autoscaled = replay(b)
+        finally:
+            ctl.stop()
+            b.stop()
+        actions = list(ctl.actions)
+        # chip-seconds = integral of scale units over the trace,
+        # piecewise-constant from the action log (t_s offsets are
+        # from controller start, which immediately precedes replay
+        # start; clamped to the trace window)
+        chip_s, last_t, units = 0.0, 0.0, float(floor)
+        for a in actions:
+            t = min(max(a["t_s"], 0.0), dur)
+            chip_s += units * max(0.0, t - last_t)
+            last_t, units = t, float(a["achieved_units"])
+        chip_s += units * max(0.0, dur - last_t)
+        autoscaled["chip_seconds"] = round(chip_s, 3)
+        autoscaled["chip_seconds_per_m_requests"] = per_m(
+            chip_s, autoscaled["within_slo"])
+        autoscaled["scale_actions"] = len(actions)
+        autoscaled["actions"] = actions
+        autoscaled["suppressed"] = ctl.suppressed
+        autoscaled["saturated_ticks"] = ctl.saturated_ticks
+        autoscaled["controller_errors"] = ctl.errors
+        autoscaled["flaps"] = ctl.flaps()
+        autoscaled["final_units"] = actuator.current()
+        autoscaled["cost_basis"] = actuator.cost_basis
+        leg["autoscaled"] = autoscaled
+        _mark(f"trace replay [autoscaled]: attainment "
+              f"{autoscaled['slo_attainment']:.3f}, "
+              f"{len(actions)} scale actions "
+              f"({autoscaled['suppressed']} suppressed, "
+              f"{autoscaled['flaps']} flaps, "
+              f"{autoscaled['saturated_ticks']} saturated ticks), "
+              f"{autoscaled['chip_seconds']} chip-s")
+
+        # The acceptance bars, with the honest-miss disclosure: when
+        # the static control already attains ~everything (the host
+        # outruns the trace), there is no headroom for the autoscaler
+        # to buy and the record says so instead of claiming a win.
+        reachable = static["slo_attainment"] < 0.995
+        st_cpm = static["chip_seconds_per_m_requests"]
+        as_cpm = autoscaled["chip_seconds_per_m_requests"]
+        leg["bars"] = {
+            "slo_bar_reachable": reachable,
+            "slo_attainment_improved": (
+                autoscaled["slo_attainment"] > static["slo_attainment"]
+                if reachable else None),
+            "chip_seconds_no_worse": (
+                as_cpm is not None and st_cpm is not None
+                and as_cpm <= st_cpm * 1.02
+                if reachable else None),
+            "zero_flaps": autoscaled["flaps"] == 0,
+            "scaled_up_under_load": any(
+                a["direction"] == "grow" for a in actions),
+        }
+    # headline fields (the --baseline delta rows read these): the
+    # autoscaled phase when it ran, the static control otherwise
+    head = autoscaled if autoscaled is not None else static
+    leg["slo_attainment"] = head["slo_attainment"]
+    leg["chip_seconds_per_m_requests"] = (
+        head["chip_seconds_per_m_requests"])
+    leg["scale_actions"] = (autoscaled or {}).get("scale_actions", 0)
+
+    if chaos and autoscale:
+        # PR 5 chaos under the trace (the README's "scale-up during a
+        # fault storm" row): the SAME schedule, autoscaled, with a
+        # seeded dispatch-latency + poison schedule installed — the
+        # injected latency inflates the saturation surface, so the
+        # controller should buy capacity DURING the storm; the leg
+        # records whether it did and what that cost.
+        from distributedmnist_tpu.serve import faults
+        fault_spec = "engine.dispatch:p=0.05,latency_ms=5"
+        _mark(f"trace replay [autoscaled + chaos {fault_spec!r}]")
+        metrics.reset()
+        faults.install(faults.FaultInjector.from_spec(fault_spec,
+                                                      seed=seed))
+        b = make_batcher(ceiling, max_batch=base_max_batch)
+        actuator = WindowActuator(b, floor=floor, ceiling=ceiling,
+                                  base_max_batch=base_max_batch)
+        actuator.scale_to(floor)
+        ctl = Autoscaler(
+            actuator,
+            batcher_signals(b, metrics=metrics, slo_ms=slo_ms),
+            high=0.6, low=0.15,
+            cooldown_s=max(0.3, dur / 24), interval_s=0.05,
+            metrics=metrics)
+        ctl.start()
+        try:
+            under = replay(b)
+        finally:
+            ctl.stop()
+            b.stop()
+            faults.uninstall()
+        under["scale_actions"] = len(ctl.actions)
+        under["grew_during_storm"] = any(
+            a["direction"] == "grow" for a in ctl.actions)
+        under["flaps"] = ctl.flaps()
+        under["fault_spec"] = fault_spec
+        leg["chaos"] = under
+        _mark(f"trace replay [chaos]: attainment "
+              f"{under['slo_attainment']:.3f}, grew_during_storm="
+              f"{under['grew_during_storm']}")
+    return leg
+
+
 def chaos_fault_spec(live_version: str, kill_target) -> str:
     """The chaos leg's programmatic fault schedule, in one place so the
     argparse-time gate and the leg itself cannot drift (ISSUE 8
@@ -2675,6 +2978,21 @@ def _baseline_delta(record: dict, baseline: dict, path: str) -> dict:
               or {}).get("balanced") or {}).get("escalation_fraction"),
             (((base_d.get("cascade") or {}).get("legs")
               or {}).get("balanced") or {}).get("escalation_fraction")),
+        # the workload-realism rows (ISSUE 20): None-vs-None without
+        # --trace-replay; autoscale-on-vs-off mixes were REFUSED
+        # before any load phase, so attainment and chip-cost always
+        # compare like with like
+        "trace_slo_attainment": (
+            (cur_d.get("trace_replay") or {}).get("slo_attainment"),
+            (base_d.get("trace_replay") or {}).get("slo_attainment")),
+        "trace_chip_s_per_m_requests": (
+            (cur_d.get("trace_replay") or {}).get(
+                "chip_seconds_per_m_requests"),
+            (base_d.get("trace_replay") or {}).get(
+                "chip_seconds_per_m_requests")),
+        "trace_scale_actions": (
+            (cur_d.get("trace_replay") or {}).get("scale_actions"),
+            (base_d.get("trace_replay") or {}).get("scale_actions")),
         # the compile-surface provenance row (ISSUE 12): static key
         # count side by side; the fingerprint-set hash comparison is
         # appended below the table (hashes don't delta as percentages).
@@ -2984,6 +3302,22 @@ def _serve(args) -> int:
                       "masquerade as a cache regression, nor a cached "
                       "round as a pipeline win)")
                 return 4
+        # Autoscale-on-vs-off trace-replay records are equally
+        # incomparable (ISSUE 20): the static control's attainment
+        # must never print a delta against an autoscaled round.
+        base_tr = baseline_rec["detail"].get("trace_replay")
+        if args.trace_replay and isinstance(base_tr, dict):
+            cur_as = bool(args.autoscale)
+            base_as = bool(base_tr.get("autoscale_enabled"))
+            if cur_as != base_as:
+                _mark(f"REFUSING --baseline {args.baseline}: its "
+                      "trace-replay leg ran autoscale_enabled="
+                      f"{base_as}, this run is autoscale_enabled="
+                      f"{cur_as} — autoscale-on-vs-off trace deltas "
+                      "are meaningless (a static control must not "
+                      "masquerade as an autoscaler regression, nor an "
+                      "autoscaled round as a static win)")
+                return 4
 
     _mark(f"warming {len(factory.buckets)} buckets "
           f"{list(factory.buckets)}")
@@ -3029,10 +3363,13 @@ def _serve(args) -> int:
                      adaptive: bool = None, wait_us: int = None,
                      resilience=None,
                      dedup: bool = False,
-                     fastlane: bool = False) -> DynamicBatcher:
+                     fastlane: bool = False,
+                     max_batch: int = None) -> DynamicBatcher:
         if adaptive is None:
             adaptive = not args.no_adaptive
-        return DynamicBatcher(router, max_batch=factory.max_batch,
+        return DynamicBatcher(router, max_batch=(factory.max_batch
+                                                 if max_batch is None
+                                                 else max_batch),
                               max_wait_us=(max_wait_us if wait_us is None
                                            else wait_us),
                               queue_depth=queue_depth,
@@ -3140,6 +3477,24 @@ def _serve(args) -> int:
         trace_leg = _serve_trace_leg(router, metrics, factory,
                                      make_batcher, pipelined, duration,
                                      low_qps, chrome_events)
+
+    # Phase 3d (optional) — the workload-realism leg (ISSUE 20): a
+    # seeded deterministic trace replayed against a static trough-
+    # provisioned config and (with --autoscale) under the closed-loop
+    # autoscaler — SLO attainment and chip-seconds per million
+    # within-SLO requests on the identical schedule, scale moving only
+    # along the warmed bucket ladder (covered by the whole-run
+    # recompile check below). With --chaos a third sub-phase replays
+    # the trace under a seeded fault storm to show the controller
+    # buying capacity through it.
+    trace_replay_leg = None
+    if args.trace_replay:
+        trace_replay_leg = _serve_trace_replay_leg(
+            router, metrics, factory, make_batcher, args.trace_replay,
+            seed=cfg.seed, autoscale=bool(args.autoscale),
+            slo_ms=(args.serve_slo_ms
+                    or (25.0 if on_cpu else 10.0)),
+            chaos=bool(args.chaos))
 
     # Phase 4 (optional) — the model roll: closed-loop traffic crossing
     # a real load + pre-warm + atomic promote (ISSUE 3 acceptance:
@@ -3388,6 +3743,14 @@ def _serve(args) -> int:
             # in-flight client, the megakernel phase + parity verdict,
             # the fastpath attribution floor, and the lane counters.
             "lowlat": lowlat_leg,
+            # The workload-realism leg (ISSUE 20; None without
+            # --trace-replay): the seeded trace spec + schedule hash,
+            # static-vs-autoscaled SLO attainment and chip-seconds per
+            # million within-SLO requests, the full scale-action log
+            # with priced decisions, the flap audit (zero by
+            # construction, counted from the log), and the acceptance
+            # bars with the slo_bar_reachable honesty disclosure.
+            "trace_replay": trace_replay_leg,
             "swap": swap,
             "chaos": chaos,
             # The tracing leg (ISSUE 9; None without --trace): the SLO

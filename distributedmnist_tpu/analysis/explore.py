@@ -942,7 +942,7 @@ def main(argv=None) -> int:
                     "findings.")
     p.add_argument("--machines",
                    default="cache,registry,batcher,batcher-nodrain,"
-                           "fleet,scheduler-wfq",
+                           "fleet,scheduler-wfq,autoscaler-loop",
                    help="comma-separated machine names (default: all)")
     p.add_argument("--schedules", type=int, default=500,
                    help="schedules per machine (default 500 — the "

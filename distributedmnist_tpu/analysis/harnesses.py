@@ -958,6 +958,175 @@ class SchedulerWFQMachine:
         self.invariant(ctl)
 
 
+# -- machine 7: autoscaler control loop (ISSUE 20) -------------------------
+
+
+class _ScaleRecorder:
+    """Actuator-shaped fake under the real Autoscaler: records every
+    scale_to target, applies the clamp a real actuator would, and can
+    die exactly once mid-decision (`die_on_call` = index of the call
+    that raises BEFORE applying — the worker-died-while-draining case).
+    The entry/exit counters straddle the instrumented state lock, so if
+    two actuations ever overlap there is a schedule where both callers
+    sit inside scale_to at once and `overlaps` catches it."""
+
+    kind = "fake"
+    cost_basis = "fake-units"
+
+    def __init__(self, floor: int, ceiling: int,
+                 die_on_call: int = None):
+        self.floor = floor
+        self.ceiling = ceiling
+        self._lock = make_lock("harness.asc.units")
+        self._units = floor
+        self.calls: list = []
+        self.deaths = 0
+        self._die_on_call = die_on_call
+        self._in_flight = 0          # mutated only between yield points
+        self.overlaps = 0
+
+    def current(self) -> int:
+        with self._lock:
+            return self._units
+
+    def scale_to(self, units: int) -> int:
+        self._in_flight += 1
+        if self._in_flight > 1:
+            self.overlaps += 1
+        try:
+            with self._lock:
+                n = len(self.calls)
+                self.calls.append(units)
+                if self._die_on_call is not None and n == self._die_on_call:
+                    self.deaths += 1
+                    raise RuntimeError(
+                        "worker died mid-drain (injected)")
+                self._units = min(max(units, self.floor), self.ceiling)
+                return self._units
+        finally:
+            self._in_flight -= 1
+
+    def capacity_rows_per_s(self, units: int):
+        return 100.0 * min(max(units, 1), self.ceiling)
+
+    def chip_fraction(self, units: int) -> float:
+        return float(min(max(units, 1), self.ceiling))
+
+    def close(self) -> None:
+        pass
+
+
+class _SignalBox:
+    """Mutable saturation surface: the load-spike thread writes a
+    pressure level, the control loop reads it through the same
+    instrumented lock — every read is a yield point, so decisions can
+    land on either side of a spike edge."""
+
+    def __init__(self):
+        self._lock = make_lock("harness.asc.signals")
+        self._queue_frac = 0.0
+
+    def set(self, frac: float) -> None:
+        with self._lock:
+            self._queue_frac = frac
+
+    def read(self):
+        from distributedmnist_tpu.serve.autoscale import Signals
+
+        with self._lock:
+            return Signals(queue_frac=self._queue_frac,
+                           inflight_frac=0.0, shed_delta=0)
+
+
+class AutoscalerLoopMachine:
+    """The REAL Autoscaler control loop (ISSUE 20) over a recording
+    fake actuator and a mutable signal box: the started loop thread
+    races a load-spike driver (manual tick()s at pressure 1.0, then a
+    drop to trough), a second trough driver, one injected mid-decision
+    actuator death, and a racing stop(). The contract: no deadlock
+    (the explorer's own detector), actuations NEVER overlap (the admin
+    lock serializes manual ticks against the loop), every target the
+    loop hands the actuator and every achieved scale stays inside
+    [floor, ceiling], the injected death is absorbed as a counted
+    error with the loop still alive to act again, and stop() joins the
+    loop thread even when it lands mid-decision."""
+
+    name = "autoscaler-loop"
+
+    def __init__(self):
+        self.act = None
+        self.asc = None
+
+    def run(self, ctl) -> None:
+        import logging
+
+        from distributedmnist_tpu.serve.autoscale import Autoscaler
+
+        # the injected death is EXPECTED here — don't spray its
+        # warning across every explored schedule's output
+        logging.getLogger("serve.autoscale").setLevel(logging.ERROR)
+        self.act = _ScaleRecorder(floor=1, ceiling=3, die_on_call=1)
+        self.sigs = _SignalBox()
+        # cooldown 0: every decision may act, so the overlap/bounds
+        # invariants face the max actuation rate (flap counting is the
+        # bench's job; this machine stresses the serialization)
+        self.asc = asc = Autoscaler(
+            self.act, self.sigs.read, high=0.7, low=0.2,
+            cooldown_s=0.0, interval_s=0.001)
+        asc.start()
+
+        def spike():
+            # pin pressure above the high band, force decisions racing
+            # the loop thread's own ticks, then drop off the cliff
+            self.sigs.set(1.0)
+            for _ in range(3):
+                asc.tick()
+            self.sigs.set(0.0)
+            asc.tick()
+
+        def trough():
+            self.sigs.set(0.05)
+            asc.tick()
+
+        threads = [ctl.spawn(spike, "load-spike"),
+                   ctl.spawn(trough, "trough"),
+                   ctl.spawn(asc.stop, "stopper")]
+        for t in threads:
+            t.join()
+        asc.stop()              # idempotent: second stop is a no-op
+
+    def invariant(self, ctl) -> None:
+        a = self.act
+        if a is None:
+            return
+        assert a.overlaps == 0, (
+            f"{a.overlaps} overlapping actuation(s) — the admin lock "
+            "failed to serialize a manual tick against the loop")
+        if ctl.lock_free("harness.asc.units"):
+            assert a.floor <= a._units <= a.ceiling, (
+                f"scale {a._units} escaped [{a.floor}, {a.ceiling}]")
+
+    def final(self, ctl) -> None:
+        a, asc = self.act, self.asc
+        assert asc._thread is None, "loop thread not joined by stop()"
+        assert a.overlaps == 0, (
+            f"{a.overlaps} overlapping actuation(s) at drain")
+        assert all(a.floor <= u <= a.ceiling for u in a.calls), (
+            f"loop handed the actuator an out-of-bounds target: "
+            f"{a.calls} outside [{a.floor}, {a.ceiling}]")
+        assert a.floor <= a.current() <= a.ceiling, (
+            f"final scale {a.current()} outside "
+            f"[{a.floor}, {a.ceiling}]")
+        for rec in asc.actions:
+            assert a.floor <= rec["achieved_units"] <= a.ceiling, (
+                f"action log records out-of-bounds scale: {rec}")
+        assert asc.errors == a.deaths, (
+            f"{a.deaths} injected death(s) but {asc.errors} counted "
+            "error(s) — a failure was double-counted or swallowed")
+        assert asc.flaps() == 0
+        self.invariant(ctl)
+
+
 def _batcher_nodrain() -> BatcherMachine:
     return BatcherMachine(drain=False)
 
@@ -980,4 +1149,9 @@ MACHINES = {
     # queue accounting never tears, head-of-line blocking stays under
     # the asserted DRR skip bound.
     "scheduler-wfq": SchedulerWFQMachine,
+    # the autoscaler's closed loop vs load spikes, a mid-decision
+    # actuator death and racing stop() (ISSUE 20): actuations never
+    # overlap, scale never escapes [floor, ceiling], the death is a
+    # counted error and the loop joins cleanly.
+    "autoscaler-loop": AutoscalerLoopMachine,
 }

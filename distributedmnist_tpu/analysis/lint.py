@@ -188,6 +188,19 @@ RULES = {
         "its admission epoch). Allowed writers: __init__/"
         "__post_init__ construction, Gateway.promote_fanout, and the "
         "worker-side apply_cluster_epoch"),
+    "DML019": (
+        "autoscale actuation called outside the Autoscaler's "
+        "actuator path",
+        "the serving stack has exactly ONE capacity-actuation surface "
+        "(ISSUE 20): batcher.apply_scale (in-flight window + bucket "
+        "ceiling) and gateway.add_worker/drain_worker (fleet "
+        "membership), called only from an Actuator's scale_to. A "
+        "second caller — a handler 'helpfully' widening the window, "
+        "a drill script draining workers directly — races the "
+        "control loop's read-decide-actuate cycle and un-prices its "
+        "chip-second accounting: the loop's action log would claim a "
+        "scale the system does not have. Allowed caller: scale_to "
+        "(WindowActuator/GatewayActuator)"),
 }
 
 _PRAGMA_RE = re.compile(r"lint:\s*allow\[(DML\d{3})\]\s*(\S.*)?")
@@ -687,6 +700,48 @@ def _check_dml018(tree: ast.AST, rel: str, findings: list) -> None:
     visit(tree, "")
 
 
+# DML019: the capacity-actuation method names (ISSUE 20) and the only
+# function name allowed to call them. scale_to is both actuators'
+# single entry point; everything else calling an actuation method is a
+# second scaler racing the control loop.
+_ACTUATION_CALLS = frozenset(
+    ("apply_scale", "add_worker", "drain_worker"))
+_ACTUATION_CALLERS = frozenset(("scale_to",))
+
+
+def _check_dml019(tree: ast.AST, rel: str, findings: list) -> None:
+    """Capacity actuation flows ONLY through the Autoscaler's actuator
+    path (ISSUE 20): any call to an actuation method (`apply_scale`,
+    `add_worker`, `drain_worker` as attribute calls) whose innermost
+    enclosing function is not `scale_to` — or that sits at module
+    level — is a finding. Same enclosing-name discipline as DML018:
+    the contract is about WHICH code path may move capacity, not how
+    it locks while doing so."""
+
+    def visit(node: ast.AST, func: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                visit(child, child.name)
+                continue
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _ACTUATION_CALLS
+                    and func not in _ACTUATION_CALLERS):
+                where = (f"function {func!r}" if func
+                         else "module level")
+                findings.append(Finding(
+                    rel, child.lineno, "DML019",
+                    f"actuation call {child.func.attr!r} at {where} "
+                    "— capacity moves only through an Actuator's "
+                    "scale_to (serve/autoscale.py); a second caller "
+                    "races the control loop's decisions and un-"
+                    "prices its chip-second accounting"))
+            visit(child, func)
+
+    visit(tree, "")
+
+
 def _check_dml011(tree: ast.AST, rel: str, findings: list) -> None:
     defs = {n.name: n for n in ast.walk(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
@@ -1100,6 +1155,10 @@ def _dml018_scope(rel: str) -> bool:
     return _in_serve_pkg(rel) or rel == "serve.py"
 
 
+def _dml019_scope(rel: str) -> bool:
+    return _in_serve_pkg(rel) or rel == "serve.py"
+
+
 def _dml012_scope(rel: str) -> bool:
     # engine.py IS the staging path; quantize.py is build-time weight
     # preparation the engine device_puts as a whole.
@@ -1379,6 +1438,10 @@ def lint_source(text: str, rel: str) -> list:
     # (ISSUE 19).
     if _dml018_scope(rel):
         _check_dml018(tree, rel, findings)
+    # DML019: capacity actuation outside the Autoscaler's actuator
+    # path (ISSUE 20).
+    if _dml019_scope(rel):
+        _check_dml019(tree, rel, findings)
     return findings
 
 

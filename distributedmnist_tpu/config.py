@@ -261,6 +261,25 @@ class Config:
     gateway_workers: int = 0
     gateway_worker_inflight: int = 8
     gateway_vnodes: int = 64
+    # Closed-loop autoscaling (ISSUE 20, serve/autoscale.py):
+    # serve_autoscale runs the hysteresis controller over the live
+    # saturation surface (queue watermark, in-flight depth, shed
+    # deltas, traced queue-wait p99 vs serve_slo_ms) and actuates ONE
+    # narrow interface — the batcher's in-flight window + bucket
+    # ceiling on a single host, worker spawn/drain under a gateway.
+    # Floor/ceiling are HARD bounds in actuator units; a tick that
+    # wants past the ceiling is disclosed as saturation, never acted.
+    # high/low are the hysteresis bands on the normalized pressure
+    # signal (grow at >= high, shrink at <= low, dead zone between);
+    # cooldown_s suppresses any action inside the window after one
+    # (the anti-flap guarantee); interval_s is the control tick.
+    serve_autoscale: bool = False
+    serve_autoscale_floor: int = 1
+    serve_autoscale_ceiling: Optional[int] = None
+    serve_autoscale_interval_s: float = 0.25
+    serve_autoscale_cooldown_s: float = 2.0
+    serve_autoscale_high: float = 0.75
+    serve_autoscale_low: float = 0.25
     # Flatten params/grads/moments into one contiguous vector inside the
     # optimizer update (optax.flatten): one fused elementwise update over
     # 61k/101k params instead of dozens of tiny per-leaf ops — measured
@@ -517,6 +536,39 @@ def add_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="[serving] virtual nodes per worker on the "
                         "consistent-hash ring (more = smoother key "
                         "spread)")
+    p.add_argument("--serve-autoscale", dest="serve_autoscale",
+                   action="store_true", default=None,
+                   help="[serving] run the closed-loop autoscaler "
+                        "(serve/autoscale.py): a hysteresis controller "
+                        "over queue watermark / in-flight depth / shed "
+                        "deltas / traced p99 that widens or narrows the "
+                        "batcher's in-flight window + bucket ceiling "
+                        "(single host) or spawns/drains workers (under "
+                        "--gateway), with cooldown anti-flap and hard "
+                        "floor/ceiling bounds")
+    p.add_argument("--serve-autoscale-floor", type=int, default=None,
+                   help="[serving] hard autoscale floor in actuator "
+                        "units (window slots or workers; default 1)")
+    p.add_argument("--serve-autoscale-ceiling", type=int, default=None,
+                   help="[serving] hard autoscale ceiling in actuator "
+                        "units (default: the actuator's natural bound "
+                        "— the constructed in-flight window, or "
+                        "2x the initial worker count)")
+    p.add_argument("--serve-autoscale-interval-s", type=float,
+                   default=None,
+                   help="[serving] autoscaler control-tick period in "
+                        "seconds (default 0.25)")
+    p.add_argument("--serve-autoscale-cooldown-s", type=float,
+                   default=None,
+                   help="[serving] minimum seconds between actuated "
+                        "scale decisions; any decision inside the "
+                        "window is suppressed and counted (default 2)")
+    p.add_argument("--serve-autoscale-high", type=float, default=None,
+                   help="[serving] grow when normalized pressure >= "
+                        "this hysteresis band (default 0.75)")
+    p.add_argument("--serve-autoscale-low", type=float, default=None,
+                   help="[serving] shrink when normalized pressure <= "
+                        "this band (default 0.25); must be < high")
     p.add_argument("--serve-retry-after-cap-s", type=float, default=None,
                    help="[serving] ceiling on the pipeline-derived "
                         "Retry-After header (integer seconds per "

@@ -1,0 +1,479 @@
+"""Closed-loop autoscaling over the serving stack (ISSUE 20).
+
+Clipper-style adaptive serving and Clockwork's predictability-first
+resource decisions (PAPERS.md) both presuppose a controller that reacts
+to load; the repo already emits every input such a controller needs —
+the PR 9 saturation surface (queue watermark, in-flight depth,
+per-stage p99 from the tracer, shed counters) and the PR 4 warmup-
+priced cost tables — but until this module nothing closed the loop.
+
+The **Autoscaler** is a control thread that reads the live saturation
+surface each tick and actuates through ONE narrow interface:
+
+    Actuator.scale_to(units) -> achieved units
+
+with exactly two implementations —
+
+    WindowActuator    single host: units widen/narrow the batcher's
+                      in-flight window AND walk its coalescing bucket
+                      ceiling along the engine's PRE-WARMED bucket
+                      ladder (bigger batches amortize dispatch overhead
+                      at zero new jit keys — scale-up never recompiles)
+    GatewayActuator   fleet: units spawn/drain whole gateway workers
+                      (PR 19) — grow joins a freshly spawned worker to
+                      the ring, shrink ring-exits + drains one
+
+Control discipline (the flap-prevention contract the bench asserts):
+
+- **hysteresis bands**: grow only above the `high` pressure watermark,
+  shrink only below `low` — the dead band between them absorbs noise.
+- **cooldown**: after any action, further actions are suppressed for
+  `cooldown_s` (counted + exported) — a grow can never be immediately
+  reversed by a shrink inside one window, so the zero-flap acceptance
+  bar holds by construction, not by tuning.
+- **floor/ceiling**: hard bounds from config, enforced at decision
+  time AND inside both actuators (a bug in one layer cannot scale to
+  zero or past the provisioned ceiling). A tick that wants to grow
+  past the ceiling marks `saturated` on its decision — the disclosed
+  "ceiling hit" state the bench and README surface.
+- **cost-model pricing**: every action is priced before it is taken —
+  chip-seconds/second bought (the reserved-capacity delta, on the
+  actuator's disclosed `cost_basis`) against the predicted capacity
+  gain in rows/s from the warmup-measured bucket-cost affine fit. The
+  price rides the action record and the
+  `dmnist_serve_autoscale_last_cost_chip_seconds` gauge.
+
+Pressure is the max of the normalized saturation signals:
+queue_frac (pending rows / backpressure watermark), inflight_frac
+(in-flight batches / live window), a shed spike (any rejection since
+the last tick pins pressure to 1.0 — shedding IS saturation), and the
+SLO ratio (p99/SLO, scaled so a breach alone clears the high band).
+
+Lint DML019 fences the actuation surface: `apply_scale` /
+`add_worker` / `drain_worker` calls outside Actuator.scale_to are
+findings — a second writer would race this loop's decisions and
+un-price its accounting. All primitives come from analysis/locks.py
+(sanitizer + schedule-explorer instrumented; the `autoscaler-loop`
+machine explores this loop against load spikes, a mid-decision worker
+death, and racing stop()).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Optional
+
+from distributedmnist_tpu.analysis.locks import (make_condition,
+                                                 make_lock, make_thread)
+
+log = logging.getLogger("serve.autoscale")
+
+
+@dataclasses.dataclass(frozen=True)
+class Signals:
+    """One tick's saturation surface. Every field is already
+    normalized or absolute — the Autoscaler does no I/O itself, so a
+    fake signal source makes the whole loop explorable/testable."""
+
+    queue_frac: float                  # pending rows / queue watermark
+    inflight_frac: float               # in-flight batches / live window
+    shed_delta: int                    # rejections since previous tick
+    p99_ms: Optional[float] = None     # stage/end-to-end p99 if known
+    slo_ms: Optional[float] = None     # the objective p99 is judged by
+
+    def pressure(self) -> float:
+        p = max(self.queue_frac, self.inflight_frac)
+        if self.shed_delta > 0:
+            p = max(p, 1.0)            # shedding IS saturation
+        if self.p99_ms is not None and self.slo_ms:
+            # scaled so p99 == SLO reads 1.0 — a breach alone must
+            # clear any sane high watermark
+            p = max(p, self.p99_ms / self.slo_ms)
+        return p
+
+
+def batcher_signals(batcher, metrics=None,
+                    slo_ms: Optional[float] = None,
+                    tracer=None) -> Callable[[], Signals]:
+    """The single-host signal source: a closure over the live batcher
+    (+ optional ServeMetrics shed counter and tracer queue-wait p99).
+    Holds no locks across reads — each accessor locks internally."""
+    last_rejected = [metrics.rejected_total() if metrics is not None
+                     else 0]
+
+    def read() -> Signals:
+        pending = batcher.pending_rows()
+        depth = batcher.inflight_batches()
+        window = max(batcher.window(), 1)
+        shed = 0
+        if metrics is not None:
+            total = metrics.rejected_total()
+            shed = total - last_rejected[0]
+            last_rejected[0] = total
+        p99 = None
+        if tracer is not None:
+            p99 = tracer.stage_p99_ms("queue.wait")
+        return Signals(
+            queue_frac=pending / max(batcher.queue_depth, 1),
+            inflight_frac=depth / window,
+            shed_delta=shed, p99_ms=p99, slo_ms=slo_ms)
+
+    return read
+
+
+# -- actuators -------------------------------------------------------------
+
+
+class WindowActuator:
+    """Single-host actuation: unit u maps to (in-flight window u,
+    bucket ceiling u-1 rungs above the base bucket) — both sides of
+    the same capacity knob, moved together through the batcher's ONE
+    actuation surface. Every rung is a bucket the engine warmed at
+    boot, so scaling never compiles (the recompiles_after_warmup==0
+    bar survives autoscaling by construction).
+
+    chip-second accounting (`cost_basis`): units are reserved in-flight
+    window slots on ONE chip — slot-seconds, not extra silicon. The
+    gateway actuator's basis is worker-chip-seconds (real chips); the
+    bench discloses whichever basis priced its record.
+    """
+
+    kind = "window"
+    cost_basis = "inflight-window-slot-seconds"
+
+    def __init__(self, batcher, floor: int, ceiling: int,
+                 base_max_batch: Optional[int] = None):
+        if not 1 <= floor <= ceiling:
+            raise ValueError(
+                f"need 1 <= floor <= ceiling, got [{floor}, {ceiling}]")
+        self._batcher = batcher
+        self.floor = floor
+        self.ceiling = min(ceiling, batcher.max_inflight)
+        buckets = list(batcher.engine.buckets)
+        base = base_max_batch or batcher.max_batch
+        base_idx = next((i for i, b in enumerate(buckets) if b >= base),
+                        len(buckets) - 1)
+        # unit u's bucket ceiling: u - floor rungs above the base,
+        # clamped to the warmed ladder top
+        self._plan = {
+            u: (u, buckets[min(base_idx + (u - self.floor),
+                               len(buckets) - 1)])
+            for u in range(1, self.ceiling + 1)}
+        self._units = min(max(self._current_window(), self.floor),
+                          self.ceiling)
+
+    def _current_window(self) -> int:
+        return self._batcher.window()
+
+    def current(self) -> int:
+        return self._units
+
+    def plan(self, units: int) -> tuple:
+        u = min(max(units, 1), self.ceiling)
+        return self._plan[u]
+
+    def scale_to(self, units: int) -> int:
+        """Apply unit target through the batcher's actuation surface;
+        returns the ACHIEVED units (narrowing can be partial while the
+        pipeline is full — the next tick retries)."""
+        u = min(max(units, self.floor), self.ceiling)
+        window, max_batch = self._plan[u]
+        got = self._batcher.apply_scale(window=window,
+                                        max_batch=max_batch)
+        # achieved units: the window actually reached (bucket ceiling
+        # always applies — it is a lock-guarded assignment)
+        self._units = min(max(got["window"], 1), self.ceiling)
+        return self._units
+
+    def capacity_rows_per_s(self, units: int) -> Optional[float]:
+        """Predicted steady-state capacity at `units` from the warmup
+        cost table: the unit's bucket ceiling amortized over its fitted
+        dispatch cost. None before the table is complete (pricing then
+        reports unknown instead of a guess)."""
+        from distributedmnist_tpu.serve.scheduler import (
+            estimate_dispatch_s)
+        engine = self._batcher.engine
+        costs = engine.bucket_costs()
+        buckets = list(engine.buckets)
+        if not costs or not all(b in costs for b in buckets):
+            return None
+        _, bucket = self.plan(units)
+        cost = estimate_dispatch_s(bucket, buckets, costs)
+        if cost <= 0:
+            return None
+        return bucket / cost
+
+    def chip_fraction(self, units: int) -> float:
+        return float(min(max(units, 1), self.ceiling))
+
+    def close(self) -> None:
+        pass                    # batcher.stop() unparks any held permits
+
+
+class GatewayActuator:
+    """Fleet actuation (PR 19): unit u = u active gateway workers.
+    Grow spawns a fresh serve.py worker (the gateway's own argv via
+    worker_argv) and joins it to the ring; shrink ring-exits + drains
+    the youngest autoscaled worker and terminates its process. The
+    spawn/drain callables are injectable so unit tests actuate
+    in-memory fakes instead of subprocesses."""
+
+    kind = "gateway"
+    cost_basis = "worker-chip-seconds"
+
+    def __init__(self, gateway, floor: int, ceiling: int,
+                 spawn: Optional[Callable] = None,
+                 terminate: Optional[Callable] = None,
+                 per_worker_rows_per_s: Optional[float] = None):
+        if not 1 <= floor <= ceiling:
+            raise ValueError(
+                f"need 1 <= floor <= ceiling, got [{floor}, {ceiling}]")
+        self._gateway = gateway
+        self.floor = floor
+        self.ceiling = ceiling
+        self._spawn = spawn
+        self._terminate = terminate or _terminate_worker
+        self._seq = 0
+        self._grown: list = []          # rids this actuator added, LIFO
+        self._per_worker = per_worker_rows_per_s
+
+    def current(self) -> int:
+        return len(self._gateway._active())
+
+    def scale_to(self, units: int) -> int:
+        u = min(max(units, self.floor), self.ceiling)
+        while self.current() < u:
+            self._seq += 1
+            rid = f"as{self._seq}"
+            worker = self._spawn(rid)   # may raise: loop reports + retries
+            self._gateway.add_worker(worker)
+            self._grown.append(rid)
+        while self.current() > u:
+            # drain the youngest autoscaled worker first; never a
+            # boot-time member unless the actuator grew none
+            rid = (self._grown.pop() if self._grown else
+                   self._gateway._active()[-1].rid)
+            worker = self._gateway.drain_worker(rid)
+            self._terminate(worker)
+        return self.current()
+
+    def capacity_rows_per_s(self, units: int) -> Optional[float]:
+        if self._per_worker is None:
+            return None
+        return self._per_worker * min(max(units, 1), self.ceiling)
+
+    def chip_fraction(self, units: int) -> float:
+        return float(min(max(units, 1), self.ceiling))
+
+    def close(self) -> None:
+        pass
+
+
+def _terminate_worker(worker) -> None:
+    try:
+        worker.transport.close()
+    except Exception:
+        pass
+    if getattr(worker, "proc", None) is not None:
+        worker.proc.terminate()
+
+
+# -- the control loop ------------------------------------------------------
+
+
+class Autoscaler:
+    """The closed control loop: read Signals, decide against the
+    hysteresis bands, price the step, actuate — one action per tick at
+    most, never inside the cooldown window. `tick()` is public and
+    synchronous (tests and the schedule explorer drive it directly);
+    `start()` runs it on a named daemon thread every `interval_s`.
+
+    Thread-safety: decisions + actuation serialize on one admin lock
+    (blocking_ok — GatewayActuator spawns processes under it BY
+    DESIGN; nothing on the request path ever takes it), so a manual
+    tick() racing the loop thread can never double-actuate. stop()
+    wakes and joins the loop; a stop() landing mid-decision waits for
+    that decision to finish rather than abandoning a half-applied
+    scale."""
+
+    def __init__(self, actuator, signals: Callable[[], Signals], *,
+                 floor: Optional[int] = None,
+                 ceiling: Optional[int] = None,
+                 high: float = 0.75, low: float = 0.25,
+                 cooldown_s: float = 2.0, interval_s: float = 0.25,
+                 metrics=None):
+        if not 0.0 <= low < high:
+            raise ValueError(
+                f"need 0 <= low < high, got low={low} high={high}")
+        if cooldown_s < 0 or interval_s <= 0:
+            raise ValueError("cooldown_s must be >= 0 and "
+                             "interval_s > 0")
+        self.actuator = actuator
+        self._signals = signals
+        self.floor = max(floor if floor is not None else actuator.floor,
+                         actuator.floor)
+        self.ceiling = min(ceiling if ceiling is not None
+                           else actuator.ceiling, actuator.ceiling)
+        if self.floor > self.ceiling:
+            raise ValueError(
+                f"floor {self.floor} exceeds ceiling {self.ceiling}")
+        self.high = high
+        self.low = low
+        self.cooldown_s = cooldown_s
+        self.interval_s = interval_s
+        self.metrics = metrics
+        self._cond = make_condition("autoscale.tick")
+        self._act_lock = make_lock("autoscale.admin", blocking_ok=True)
+        self._stop = False
+        self._thread = None
+        self._t0 = time.monotonic()
+        self._last_action_t: Optional[float] = None
+        # action log: one dict per APPLIED action (the bench's flap
+        # audit + the artifact's scale_actions record). Guarded by
+        # _act_lock — appended only inside tick().
+        self.actions: list = []
+        self.suppressed = 0             # cooldown-suppressed decisions
+        self.errors = 0                 # actuation failures (retried)
+        self.saturated_ticks = 0        # grow wanted past the ceiling
+        if self.metrics is not None:
+            self.metrics.record_autoscale_scale(actuator.current())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            raise RuntimeError("autoscaler already started")
+        self._thread = make_thread(target=self._loop,
+                                   name="serve-autoscale", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+        self._thread = None
+        self.actuator.close()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                self._cond.wait(self.interval_s)
+                if self._stop:
+                    return
+            try:
+                self.tick()
+            except Exception:
+                # a torn signal source or actuator must never kill the
+                # loop — the next tick re-reads fresh state
+                self.errors += 1
+                log.exception("autoscale tick failed; retrying")
+
+    # -- one decision ------------------------------------------------------
+
+    def tick(self) -> Optional[dict]:
+        """One read-decide-price-actuate cycle. Returns the applied
+        action record, or None (in band / cooldown / at a bound /
+        actuation failed)."""
+        with self._act_lock:
+            sig = self._signals()
+            pressure = sig.pressure()
+            cur = self.actuator.current()
+            if pressure >= self.high:
+                target = cur + 1
+            elif pressure <= self.low:
+                target = cur - 1
+            else:
+                return None
+            if target > self.ceiling:
+                # ceiling hit: disclosed saturation, not silent clamping
+                self.saturated_ticks += 1
+                if self.metrics is not None:
+                    self.metrics.record_autoscale_saturated()
+                return None
+            if target < self.floor:
+                return None
+            now = time.monotonic()
+            if (self._last_action_t is not None
+                    and now - self._last_action_t < self.cooldown_s):
+                self.suppressed += 1
+                if self.metrics is not None:
+                    self.metrics.record_autoscale_suppressed()
+                return None
+            direction = "grow" if target > cur else "shrink"
+            # price BEFORE actuating: chip-seconds/second bought vs the
+            # cost model's predicted capacity delta
+            price = (self.actuator.chip_fraction(target)
+                     - self.actuator.chip_fraction(cur))
+            cap_cur = self.actuator.capacity_rows_per_s(cur)
+            cap_new = self.actuator.capacity_rows_per_s(target)
+            gain = (cap_new - cap_cur
+                    if cap_cur is not None and cap_new is not None
+                    else None)
+            try:
+                achieved = self.actuator.scale_to(target)
+            except Exception as e:
+                # mid-decision actuator death (a worker that died while
+                # being drained/joined): count, keep the loop alive —
+                # the next tick re-reads the real fleet state
+                self.errors += 1
+                log.warning("autoscale %s %d -> %d failed: %s",
+                            direction, cur, target, e)
+                return None
+            self._last_action_t = now
+            action = {
+                "t_s": round(now - self._t0, 4),
+                "direction": direction,
+                "from_units": cur, "to_units": target,
+                "achieved_units": achieved,
+                "pressure": round(pressure, 4),
+                "price_chip_s_per_s": price,
+                "predicted_gain_rows_per_s":
+                    round(gain, 2) if gain is not None else None,
+                "cost_basis": self.actuator.cost_basis,
+            }
+            self.actions.append(action)
+            if self.metrics is not None:
+                self.metrics.record_autoscale_action(
+                    direction, achieved, price)
+            return action
+
+    # -- reporting ---------------------------------------------------------
+
+    def describe(self) -> dict:
+        with self._act_lock:
+            return {
+                "actuator": self.actuator.kind,
+                "cost_basis": self.actuator.cost_basis,
+                "floor": self.floor, "ceiling": self.ceiling,
+                "high": self.high, "low": self.low,
+                "cooldown_s": self.cooldown_s,
+                "interval_s": self.interval_s,
+                "scale": self.actuator.current(),
+                "actions": list(self.actions),
+                "suppressed": self.suppressed,
+                "errors": self.errors,
+                "saturated_ticks": self.saturated_ticks,
+            }
+
+    def flaps(self, cooldown_s: Optional[float] = None) -> int:
+        """Grow-immediately-reversed-by-shrink pairs inside one
+        cooldown window (either order) — the acceptance bar counts
+        ZERO of these. Computed from the action log so the artifact's
+        claim is auditable, not asserted."""
+        win = cooldown_s if cooldown_s is not None else self.cooldown_s
+        n = 0
+        with self._act_lock:
+            acts = list(self.actions)
+        for a, b in zip(acts, acts[1:]):
+            if (a["direction"] != b["direction"]
+                    and b["t_s"] - a["t_s"] < win):
+                n += 1
+        return n

@@ -259,6 +259,15 @@ class DynamicBatcher:
         # (acquires minus releases must net zero at drain — ISSUE 8).
         self._slots = make_semaphore("batcher.inflight_slots",
                                      self.max_inflight)
+        # Autoscale actuation (ISSUE 20): the semaphore's capacity is
+        # FIXED at max_inflight (the hard ceiling); the live window is
+        # narrowed by PARKING permits — apply_scale acquires them and
+        # holds, so the dispatch/fastlane acquire paths see a smaller
+        # window with zero new mechanism. Parked permits are returned
+        # at stop() (and on widen), so the sanitizer's balance contract
+        # (net zero at drain, never negative) holds by construction:
+        # permits are never minted or destroyed at runtime.
+        self._window_parked = 0          # guarded by self._cond
         self._inflight = 0
         # DISPATCHED-but-unresolved segments only (each holds a window
         # slot, so this never exceeds max_inflight): the depth gauge
@@ -391,6 +400,70 @@ class DynamicBatcher:
         with self._inflight_lock:
             return self._inflight
 
+    # -- autoscale actuation (ISSUE 20) ------------------------------------
+
+    def window(self) -> int:
+        """The LIVE in-flight window: the constructed ceiling minus the
+        permits apply_scale has parked."""
+        with self._cond:
+            return self.max_inflight - self._window_parked
+
+    def apply_scale(self, window: Optional[int] = None,
+                    max_batch: Optional[int] = None,
+                    timeout_s: float = 1.0) -> dict:
+        """The single-host actuation surface (ISSUE 20): widen/narrow
+        the in-flight window and/or the coalescing bucket ceiling at
+        runtime. ONLY the autoscaler's actuator path may call this
+        (lint DML019) — a second writer would race the control loop's
+        decisions and un-price its cost accounting.
+
+        Window moves by parking/unparking permits on the fixed-capacity
+        slot semaphore: narrowing acquires (and holds) permits, widening
+        releases held ones — never past the constructed max_inflight
+        ceiling, never minting permits. Narrowing waits up to
+        `timeout_s` PER PERMIT for in-flight batches to drain; a
+        timeout returns the partially-applied window honestly rather
+        than blocking the control loop (the next tick retries).
+
+        max_batch moves within the engine's pre-warmed bucket ladder —
+        clamped to buckets[-1], so a scale-up amortizes dispatch
+        overhead over a fuller batch with ZERO new jit keys (the
+        recompiles_after_warmup==0 guarantee is untouched by design).
+
+        Returns {"window": achieved, "max_batch": achieved}.
+        """
+        if window is not None:
+            if window < 1:
+                raise ValueError(f"window must be >= 1, got {window}")
+            target = min(window, self.max_inflight)
+            while True:
+                with self._cond:
+                    cur = self.max_inflight - self._window_parked
+                    if cur < target:          # widen: unpark
+                        n = target - cur
+                        self._window_parked -= n
+                        self._slots.release(n)
+                        break
+                    if cur == target:
+                        break
+                # narrow: park one permit at a time OUTSIDE the queue
+                # lock (the acquire may wait on a full pipeline; holding
+                # _cond across it would stall every submit)
+                if not self._slots.acquire(timeout=timeout_s):
+                    break                     # partial: report honestly
+                with self._cond:
+                    self._window_parked += 1
+        if max_batch is not None:
+            if max_batch < 1:
+                raise ValueError(
+                    f"max_batch must be >= 1, got {max_batch}")
+            with self._cond:
+                self.max_batch = min(max_batch, self.engine.buckets[-1])
+                if self.controller is not None:
+                    # keep the AIMD fill-cap honest about the new ceiling
+                    self.controller.max_batch = self.max_batch
+        return {"window": self.window(), "max_batch": self.max_batch}
+
     # -- dispatch side -----------------------------------------------------
 
     def start(self) -> "DynamicBatcher":
@@ -428,6 +501,14 @@ class DynamicBatcher:
         dropped: list[_Request] = []
         with self._cond:
             self._stop = True
+            # Return any autoscale-parked window permits (ISSUE 20):
+            # the sanitizer balance-checks the slot semaphore at drain
+            # (net zero), and a narrowed window must not throttle the
+            # final drain anyway. Stop the Autoscaler BEFORE the
+            # batcher so it cannot re-park after this.
+            if self._window_parked:
+                self._slots.release(self._window_parked)
+                self._window_parked = 0
             if not drain:
                 while self._q:
                     req = self._q.popleft()

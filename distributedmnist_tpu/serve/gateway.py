@@ -492,6 +492,76 @@ class Gateway:
             return True
         return isinstance(exc, ConnectionRefusedError)
 
+    # -- autoscale actuation (ISSUE 20) ------------------------------------
+
+    def add_worker(self, worker: _Worker) -> None:
+        """Autoscaler grow actuation — ONLY the autoscaler's actuator
+        path calls this (lint DML019); boot-time membership goes
+        through the constructor. Joins an already-spawned worker to
+        the pick set and the ring, under the admin lock so a join can
+        never interleave with a promote fan-out (a worker added
+        mid-flip would miss the flip and serve the old version behind
+        a new epoch). The worker is seeded with the current cluster
+        epoch BEFORE it enters the ring: its very first reply must
+        stamp correctly or the gateway itself would reject it as
+        mixed-epoch."""
+        with self._admin:
+            with self._cond:
+                if worker.rid in self._workers:
+                    raise ValueError(
+                        f"worker {worker.rid!r} already joined")
+                epoch = self._cluster_epoch
+            try:
+                worker.transport.request(
+                    "POST", "/cluster/epoch",
+                    json.dumps({"epoch": epoch}).encode(),
+                    {"Content-Type": "application/json"})
+            except Exception as e:
+                log.warning("gateway: epoch seed to joining worker "
+                            "%s failed: %s", worker.rid, e)
+            with self._cond:
+                self._workers[worker.rid] = worker
+                self.ring.add(worker.rid)
+                self._cond.notify_all()
+        log.info("gateway: worker %s (port %d) JOINED the ring "
+                 "(autoscale)", worker.rid, worker.port)
+
+    def drain_worker(self, rid: str,
+                     timeout_s: float = 30.0) -> _Worker:
+        """Autoscaler shrink actuation — ONLY the autoscaler's
+        actuator path calls this (lint DML019). Two-step exit: the
+        worker leaves the ring and the pick set FIRST (no new
+        admissions; its keys migrate to ring successors exactly as on
+        death, but without failing anything), then its in-flight
+        requests drain up to `timeout_s` before it is handed back to
+        the caller to terminate. Never drains the last active worker —
+        the floor is the actuator's contract, but a fleet of zero
+        routes nothing and must be impossible at this layer too."""
+        with self._admin:
+            with self._cond:
+                w = self._workers.get(rid)
+                if w is None or w.state != "active":
+                    raise ValueError(
+                        f"no active worker {rid!r} to drain")
+                actives = sum(1 for x in self._workers.values()
+                              if x.state == "active")
+                if actives <= 1:
+                    raise ValueError(
+                        "cannot drain the last active worker")
+                if rid in self.ring:
+                    self.ring.remove(rid)
+                w.state = "draining"
+                self._cond.notify_all()
+            deadline = time.monotonic() + timeout_s
+            with self._cond:
+                while w.inflight and time.monotonic() < deadline:
+                    self._cond.wait(0.1)
+                del self._workers[rid]
+                self._cond.notify_all()
+        log.info("gateway: worker %s (port %d) DRAINED and left the "
+                 "ring (autoscale)", w.rid, w.port)
+        return w
+
     def handle_predict(self, body: bytes, headers: dict) -> tuple:
         """Route one /predict: returns (status, response headers,
         response body bytes). Transport failure on the picked worker

@@ -144,6 +144,16 @@ class ServeMetrics:
             # bounded by configuration, never by client-chosen labels.
             self._by_tenant: dict[str, dict] = {}
             self._by_model: dict[str, dict] = {}
+            # autoscale accounting (ISSUE 20): the control loop's
+            # current scale (units on the actuator's disclosed cost
+            # basis), applied decisions by direction, decisions the
+            # cooldown suppressed, ceiling-hit ticks (disclosed
+            # saturation), and the last applied action's priced cost.
+            self._autoscale_scale: int = 0
+            self._autoscale_decisions: dict[str, int] = {}
+            self._autoscale_suppressed = 0
+            self._autoscale_saturated = 0
+            self._autoscale_last_cost: float = 0.0
 
     # -- recording hooks (called by the batcher) ---------------------------
 
@@ -366,6 +376,13 @@ class ServeMetrics:
             self._rejected_requests += 1
             self._rejected_rows += rows
 
+    def rejected_total(self) -> int:
+        """Cheap counter read for the autoscaler's shed signal (ISSUE
+        20) — snapshot() does percentile math, far too heavy for a
+        sub-second control tick."""
+        with self._lock:
+            return self._rejected_requests
+
     def record_shadow(self, live_version: str, shadow_version: str,
                       rows: int, agree_rows: int,
                       max_abs_diff: float) -> None:
@@ -487,6 +504,36 @@ class ServeMetrics:
             self._replica_trips[replica] = (
                 self._replica_trips.get(replica, 0) + 1)
 
+    def record_autoscale_scale(self, units: int) -> None:
+        """The autoscaler announced its starting scale (units on the
+        actuator's cost basis — window slots or workers)."""
+        with self._lock:
+            self._autoscale_scale = units
+
+    def record_autoscale_action(self, direction: str, units: int,
+                                price_chip_s: float) -> None:
+        """One APPLIED scale action: direction (grow|shrink), the
+        achieved scale, and the step's cost-model price in
+        chip-seconds per second of reserved capacity."""
+        with self._lock:
+            self._autoscale_scale = units
+            self._autoscale_decisions[direction] = (
+                self._autoscale_decisions.get(direction, 0) + 1)
+            self._autoscale_last_cost = price_chip_s
+
+    def record_autoscale_suppressed(self) -> None:
+        """A decision the cooldown window suppressed — the flap
+        counter's complement (suppressions are WHY flaps stay zero)."""
+        with self._lock:
+            self._autoscale_suppressed += 1
+
+    def record_autoscale_saturated(self) -> None:
+        """A tick that wanted to grow past the hard ceiling: disclosed
+        saturation — the operator's signal to raise provisioning, and
+        the bench's ceiling-hit failure-mode row."""
+        with self._lock:
+            self._autoscale_saturated += 1
+
     # -- reporting ---------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -574,6 +621,11 @@ class ServeMetrics:
                 "last_rollback": self._last_rollback,
                 "by_model": {m: dict(s)
                              for m, s in self._by_model.items()},
+                "autoscale_scale": self._autoscale_scale,
+                "autoscale_decisions": dict(self._autoscale_decisions),
+                "autoscale_suppressed": self._autoscale_suppressed,
+                "autoscale_saturated": self._autoscale_saturated,
+                "autoscale_last_cost": self._autoscale_last_cost,
             }
         lat_ms = {k: (round(v * 1e3, 3) if v is not None else None)
                   for k, v in percentiles(lat).items()}
@@ -725,6 +777,19 @@ class ServeMetrics:
                 "hedge_wins": c["hedge_wins"],
                 "replica_trips": sum(c["replica_trips"].values()),
                 "replica_trips_by_replica": c["replica_trips"],
+            },
+            # the control loop's operating point (ISSUE 20): current
+            # scale in actuator units, applied decisions by direction,
+            # cooldown-suppressed decisions (why flaps stay zero),
+            # ceiling-hit ticks (disclosed saturation), and the last
+            # applied step's cost-model price
+            "autoscale": {
+                "scale": c["autoscale_scale"],
+                "decisions": {k: v for k, v in
+                              sorted(c["autoscale_decisions"].items())},
+                "suppressed": c["autoscale_suppressed"],
+                "saturated_ticks": c["autoscale_saturated"],
+                "last_cost_chip_s": c["autoscale_last_cost"],
             },
             "resilience": {
                 "deadline_shed_requests": c["deadline_shed_requests"],
@@ -917,6 +982,23 @@ _PROM_HELP = {
         "Requests routed per catalog model.",
     "dmnist_serve_model_dispatched_rows_total":
         "Rows the scheduler granted per catalog model.",
+    # autoscaling control loop (ISSUE 20)
+    "dmnist_serve_autoscale_scale":
+        "Current scale in actuator units (in-flight window slots on a "
+        "single host, active workers behind the gateway).",
+    "dmnist_serve_autoscale_decisions_total":
+        "Actuated scale decisions by direction (grow / shrink).",
+    "dmnist_serve_autoscale_suppressed_total":
+        "Scale decisions suppressed by the cooldown window (the "
+        "anti-flap counter; nonzero under square-wave load is the "
+        "hysteresis doing its job).",
+    "dmnist_serve_autoscale_saturated_total":
+        "Control ticks that wanted to grow past the configured "
+        "ceiling — disclosed saturation, not silent queueing.",
+    "dmnist_serve_autoscale_last_cost_chip_seconds":
+        "Priced cost of the most recent decision: chip-seconds per "
+        "second bought (positive) or released (negative), in the "
+        "actuator's disclosed cost basis.",
 }
 
 
@@ -1119,6 +1201,21 @@ def prometheus_exposition(snapshot: dict,
     emit("dmnist_serve_model_dispatched_rows_total", "counter",
          [({"model": m}, ms.get("dispatched_rows"))
           for m, ms in bm.items()])
+    # autoscaling control loop (ISSUE 20): current scale, decision
+    # volume by direction, the cooldown/ceiling disclosures, and the
+    # priced cost of the last actuation.
+    asc = s.get("autoscale", {})
+    emit("dmnist_serve_autoscale_scale", "gauge",
+         [({}, asc.get("scale") or None)])
+    emit("dmnist_serve_autoscale_decisions_total", "counter",
+         [({"direction": d}, n)
+          for d, n in asc.get("decisions", {}).items()])
+    emit("dmnist_serve_autoscale_suppressed_total", "counter",
+         [({}, asc.get("suppressed"))])
+    emit("dmnist_serve_autoscale_saturated_total", "counter",
+         [({}, asc.get("saturated_ticks"))])
+    emit("dmnist_serve_autoscale_last_cost_chip_seconds", "gauge",
+         [({}, asc.get("last_cost_chip_s") or None)])
     if cache:
         emit("dmnist_serve_cache_hits_total", "counter",
              [({}, cache.get("hits"))])

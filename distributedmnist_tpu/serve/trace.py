@@ -563,6 +563,28 @@ class Tracer:
         else:
             h[2][-1] += 1
 
+    def stage_p99_ms(self, name: str) -> Optional[float]:
+        """Histogram-estimated p99 of one stage's recorded durations:
+        the upper bound of the bucket containing the 99th-percentile
+        sample (None until the stage has samples; the overflow bucket
+        reports twice the top bound — an honest 'at least'). The
+        autoscaler's per-stage saturation signal (ISSUE 20): a
+        queue.wait p99 climbing toward the SLO is the leading edge of
+        overload, visible before sheds start."""
+        with self._lock:
+            h = self._stages.get(name)
+            if h is None or not h[0]:
+                return None
+            target = 0.99 * h[0]
+            acc = 0
+            for i, n in enumerate(h[2]):
+                acc += n
+                if acc >= target:
+                    return (float(STAGE_BUCKETS_MS[i])
+                            if i < len(STAGE_BUCKETS_MS)
+                            else STAGE_BUCKETS_MS[-1] * 2.0)
+            return STAGE_BUCKETS_MS[-1] * 2.0
+
     # -- export ------------------------------------------------------------
 
     def traces(self) -> list:

@@ -8,18 +8,19 @@
 # driver compares across rounds.
 #
 # Marker note: the `-m 'not slow'` selection below INCLUDES the chaos,
-# fleet, quant, analysis, trace, cache, cascade, tenant and gateway
-# suites
+# fleet, quant, analysis, trace, cache, cascade, tenant, gateway and
+# autoscale suites
 # (tests/conftest.py registers the markers) — they are cheap and
 # deterministic by design, so the tier-1 gate covers fault injection,
 # the replica fleet, the quantized inference fast path, the
 # concurrency sanitizer/lint, the request tracer, the prediction-cache
-# front layer, the confidence-gated cascade, and the multi-tenant
+# front layer, the confidence-gated cascade, the multi-tenant
 # scheduler (quota admission, DRR fairness, EDF shedding, the
-# two-model catalog) on every run.
+# two-model catalog), and the trace-replay/autoscaler control loop on
+# every run.
 # `pytest -m quant` / `-m analysis` / `-m trace` / `-m cache` /
-# `-m cascade` / `-m tenant` / `-m gateway` select those suites
-# alone.
+# `-m cascade` / `-m tenant` / `-m gateway` / `-m autoscale` select
+# those suites alone.
 cd "$(dirname "$0")/.." || exit 1
 # The project lint runs FIRST (ISSUE 8): a lint regression (bare
 # threading primitive, unknown failpoint name, wall-clock timing, ...)

@@ -1236,6 +1236,43 @@ def main(argv=None) -> int:
     if (args.serve_trace_capacity is not None
             and args.serve_trace_capacity < 1):
         p.error("--serve-trace-capacity must be >= 1")
+    if (args.serve_autoscale_floor is not None
+            and args.serve_autoscale_floor < 1):
+        p.error("--serve-autoscale-floor must be >= 1")
+    if (args.serve_autoscale_ceiling is not None
+            and args.serve_autoscale_ceiling < 1):
+        p.error("--serve-autoscale-ceiling must be >= 1")
+    if (args.serve_autoscale_floor is not None
+            and args.serve_autoscale_ceiling is not None
+            and args.serve_autoscale_ceiling < args.serve_autoscale_floor):
+        p.error("--serve-autoscale-ceiling must be >= "
+                "--serve-autoscale-floor")
+    if (args.serve_autoscale_interval_s is not None
+            and args.serve_autoscale_interval_s <= 0):
+        p.error("--serve-autoscale-interval-s must be > 0")
+    if (args.serve_autoscale_cooldown_s is not None
+            and args.serve_autoscale_cooldown_s < 0):
+        p.error("--serve-autoscale-cooldown-s must be >= 0")
+    _as_dflt = config_lib.Config()
+    _as_high = (args.serve_autoscale_high
+                if args.serve_autoscale_high is not None
+                else _as_dflt.serve_autoscale_high)
+    _as_low = (args.serve_autoscale_low
+               if args.serve_autoscale_low is not None
+               else _as_dflt.serve_autoscale_low)
+    if not 0.0 <= _as_low < _as_high:
+        p.error("autoscale hysteresis bands need 0 <= low < high, got "
+                f"low={_as_low} high={_as_high}")
+    if args.serve_autoscale:
+        if args.serve_tenants or args.serve_models:
+            p.error("--serve-autoscale does not compose with "
+                    "multi-tenant serving yet (the global scheduler "
+                    "owns the dispatch surface)")
+        if args.gateway_workers:
+            p.error("--serve-autoscale under --gateway is not wired "
+                    "into the front door yet; the fleet actuator is "
+                    "driven through serve/autoscale.GatewayActuator "
+                    "(see bench.py serve --trace-replay)")
     if (args.serve_cache_capacity is not None
             and args.serve_cache_capacity < 1):
         p.error("--serve-cache-capacity must be >= 1")
@@ -1325,7 +1362,7 @@ def main(argv=None) -> int:
     # owned by the weighted-fair, deadline-feasibility scheduler. The
     # single-model path below stays byte-for-byte the compat default.
     tenancy_on = bool(cfg.serve_tenants or cfg.serve_models)
-    catalog = scheduler = None
+    catalog = scheduler = autoscaler = None
     if tenancy_on:
         from distributedmnist_tpu.serve import build_tenancy
         catalog, scheduler = build_tenancy(cfg, metrics=metrics)
@@ -1412,6 +1449,37 @@ def main(argv=None) -> int:
             log.info("confidence cascade REQUESTED: calibration + the "
                      "composed-accuracy gate run at warmup; X-Accuracy-"
                      "Class picks fast|balanced|exact per request")
+        # Closed-loop autoscaling (ISSUE 20): the window actuator over
+        # THIS batcher, fed by the live saturation surface. Built after
+        # every front wrapper — the loop reads/actuates the batcher
+        # directly, never the submit path.
+        if cfg.serve_autoscale:
+            from distributedmnist_tpu.serve import (
+                autoscale as autoscale_lib)
+            as_ceiling = (cfg.serve_autoscale_ceiling
+                          if cfg.serve_autoscale_ceiling is not None
+                          else batcher.max_inflight)
+            actuator = autoscale_lib.WindowActuator(
+                batcher, floor=cfg.serve_autoscale_floor,
+                ceiling=as_ceiling)
+            from distributedmnist_tpu.serve import trace as _trace_mod
+            autoscaler = autoscale_lib.Autoscaler(
+                actuator,
+                autoscale_lib.batcher_signals(
+                    batcher, metrics=metrics, slo_ms=cfg.serve_slo_ms,
+                    tracer=_trace_mod.active()),
+                high=cfg.serve_autoscale_high,
+                low=cfg.serve_autoscale_low,
+                cooldown_s=cfg.serve_autoscale_cooldown_s,
+                interval_s=cfg.serve_autoscale_interval_s,
+                metrics=metrics).start()
+            log.info("autoscaler ACTIVE (window actuator): floor %d "
+                     "ceiling %d, bands [%.2f, %.2f], cooldown %.1fs, "
+                     "tick %.2fs — scale moves only along the warmed "
+                     "bucket ladder (zero recompiles)",
+                     autoscaler.floor, autoscaler.ceiling,
+                     autoscaler.low, autoscaler.high,
+                     autoscaler.cooldown_s, autoscaler.interval_s)
     log.info("dispatch pipeline depth: %d; buckets %s",
              batcher.max_inflight, list(factory.buckets))
     state = ServerState()
@@ -1480,6 +1548,8 @@ def main(argv=None) -> int:
                                 factory.max_batch)
             if cache is not None:
                 summary["cache"] = cache.stats()
+            if autoscaler is not None:
+                summary["autoscale"] = autoscaler.describe()
         else:
             summary = _http_serve(batcher, metrics, registry, state,
                                   args.port, args.metrics_every,
@@ -1494,6 +1564,11 @@ def main(argv=None) -> int:
                                       cfg.serve_cascade_threshold),
                                   scheduler=scheduler)
     finally:
+        # The autoscaler stops BEFORE the batcher: batcher.stop()
+        # releases any window permits the actuator parked, and a live
+        # control loop could re-park them mid-drain.
+        if autoscaler is not None:
+            autoscaler.stop()
         if scheduler is not None:
             scheduler.stop()    # drains every per-model batcher too
         else:

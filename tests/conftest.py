@@ -114,6 +114,16 @@ def pytest_configure(config):
         "end-to-end); cheap and deterministic, runs in tier-1 under "
         "the serve sanitizer fixture — `-m gateway` selects just "
         "this suite (scripts/tier1.sh notes the inclusion)")
+    config.addinivalue_line(
+        "markers",
+        "autoscale: workload-realism / autoscaling test "
+        "(serve/workload.py trace-replay generation + "
+        "serve/autoscale.py: the Signals pressure surface, hysteresis "
+        "+ cooldown decisions, floor/ceiling enforcement, the window "
+        "and gateway actuators, action pricing and the Prometheus "
+        "series); cheap and deterministic, runs in tier-1 under the "
+        "serve sanitizer fixture — `-m autoscale` selects just this "
+        "suite (scripts/tier1.sh notes the inclusion)")
     # A DMNIST_SANITIZE=1 environment installs a process-global
     # sanitizer at import time — under pytest that instance must yield
     # to the per-test installs (the serve autouse fixture and the

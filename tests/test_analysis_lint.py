@@ -894,6 +894,87 @@ def test_dml018_real_promote_path_is_clean():
         assert found == [], rel
 
 
+# -- DML019: autoscale actuation containment (ISSUE 20) --------------------
+
+
+def test_dml019_bare_actuation_call_flagged():
+    """Any apply_scale/add_worker/drain_worker call outside an
+    Actuator's scale_to is a finding — a second actuation writer races
+    the control loop's decisions and un-prices its accounting."""
+    src = ("class H:\n"
+           "    def widen(self, b):\n"
+           "        b.apply_scale(window=4)\n")
+    assert _rules(src) == ["DML019"]
+    f = lint.lint_source(src, SERVE_REL)[0]
+    assert f.line == 3 and "scale_to" in f.message
+
+
+def test_dml019_every_fenced_call_covered():
+    for attr in sorted(lint._ACTUATION_CALLS):
+        src = (f"class H:\n"
+               f"    def go(self, g):\n"
+               f"        g.{attr}(1)\n")
+        assert _rules(src) == ["DML019"], attr
+
+
+def test_dml019_allowed_caller_clean():
+    """scale_to — the actuator interface both implementations live
+    behind — is the ONE legitimate caller."""
+    src = ("class A:\n"
+           "    def scale_to(self, u):\n"
+           "        self._batcher.apply_scale(window=u)\n"
+           "        self._gateway.add_worker(u)\n"
+           "        self._gateway.drain_worker(u)\n")
+    assert _rules(src) == []
+
+
+def test_dml019_nested_function_not_laundered():
+    """A closure nested inside scale_to is its own code path — the
+    enclosing-name check uses the INNERMOST function, so scale_to
+    cannot launder a deferred actuation through a callback."""
+    src = ("class A:\n"
+           "    def scale_to(self, u):\n"
+           "        def later():\n"
+           "            self._batcher.apply_scale(window=u)\n"
+           "        return later\n")
+    assert _rules(src) == ["DML019"]
+
+
+def test_dml019_module_level_and_scope():
+    """A module-level call is flagged; the rule applies to serve/ and
+    serve.py only (tests legitimately drive fakes through the raw
+    methods, and the batcher's own DEFINITION is not a call)."""
+    top = "import b\nb.batcher.apply_scale(window=2)\n"
+    assert _rules(top) == ["DML019"]
+    bare = ("class H:\n"
+            "    def poke(self, g):\n"
+            "        g.drain_worker('w1')\n")
+    assert _rules(bare, "serve.py") == ["DML019"]
+    for rel in ("tests/test_serve_autoscale.py", "bench.py",
+                "distributedmnist_tpu/analysis/harnesses.py"):
+        assert _rules(bare, rel) == [], rel
+    # defining apply_scale (the actuation surface itself) is not a call
+    defn = ("class B:\n"
+            "    def apply_scale(self, window=None):\n"
+            "        return {'window': window}\n")
+    assert _rules(defn) == []
+
+
+def test_dml019_real_actuation_paths_are_clean():
+    """The shipped actuator/batcher/gateway paths pass their own rule
+    (the repo-at-HEAD gate covers this too; asserting directly keeps
+    the failure local if a second actuation writer lands)."""
+    root = lint.repo_root()
+    for rel in ("distributedmnist_tpu/serve/autoscale.py",
+                "distributedmnist_tpu/serve/batcher.py",
+                "distributedmnist_tpu/serve/gateway.py", "serve.py"):
+        with open(os.path.join(root, rel)) as fh:
+            src = fh.read()
+        found = [f.rule for f in lint.lint_source(src, rel)
+                 if f.rule == "DML019"]
+        assert found == [], rel
+
+
 # -- allowlist pragma ------------------------------------------------------
 
 

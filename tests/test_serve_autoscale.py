@@ -1,0 +1,421 @@
+"""serve/autoscale.py: the Signals pressure surface, the Autoscaler's
+hysteresis + cooldown + floor/ceiling decision discipline (zero flaps
+by construction, disclosed saturation, absorbed actuator deaths), the
+WindowActuator against a REAL DynamicBatcher (parked-permit window
+moves, the pre-warmed bucket ladder, honest partial narrows), the
+GatewayActuator over a gateway-shaped fake (LIFO drain of autoscaled
+workers, boot members protected), cost-model pricing on every action
+record, and the ServeMetrics/Prometheus export of the whole loop."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.serve import DynamicBatcher, ServeMetrics
+from distributedmnist_tpu.serve import metrics as metrics_mod
+from distributedmnist_tpu.serve.autoscale import (Autoscaler,
+                                                  GatewayActuator,
+                                                  Signals,
+                                                  WindowActuator,
+                                                  batcher_signals)
+from tests.test_serve_batcher import StubEngine
+
+pytestmark = pytest.mark.autoscale
+
+
+# -- fakes -----------------------------------------------------------------
+
+
+class FakeActuator:
+    kind = "fake"
+    cost_basis = "fake-units"
+
+    def __init__(self, floor=1, ceiling=4, per_unit_rows=100.0,
+                 fail_next=0):
+        self.floor = floor
+        self.ceiling = ceiling
+        self.units = floor
+        self.calls = []
+        self.per_unit_rows = per_unit_rows
+        self.fail_next = fail_next
+
+    def current(self):
+        return self.units
+
+    def scale_to(self, units):
+        self.calls.append(units)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("actuation failed (injected)")
+        self.units = min(max(units, self.floor), self.ceiling)
+        return self.units
+
+    def capacity_rows_per_s(self, units):
+        if self.per_unit_rows is None:
+            return None
+        return self.per_unit_rows * min(max(units, 1), self.ceiling)
+
+    def chip_fraction(self, units):
+        return float(min(max(units, 1), self.ceiling))
+
+    def close(self):
+        pass
+
+
+class _Box:
+    """Mutable signal source: tests set the pressure a tick will see."""
+
+    def __init__(self, queue_frac=0.0, shed=0):
+        self.sig = Signals(queue_frac=queue_frac, inflight_frac=0.0,
+                           shed_delta=shed)
+
+    def read(self):
+        return self.sig
+
+
+def _asc(act, box, **kw):
+    kw.setdefault("high", 0.75)
+    kw.setdefault("low", 0.25)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("interval_s", 0.05)
+    return Autoscaler(act, box.read, **kw)
+
+
+# -- the pressure surface --------------------------------------------------
+
+
+def test_pressure_is_max_of_normalized_signals():
+    assert Signals(0.4, 0.7, 0).pressure() == pytest.approx(0.7)
+    assert Signals(0.9, 0.1, 0).pressure() == pytest.approx(0.9)
+    # shedding pins pressure to saturation regardless of the gauges
+    assert Signals(0.0, 0.0, 3).pressure() == 1.0
+    # p99 at 2x the SLO reads pressure 2.0 — a breach alone must clear
+    # any sane high watermark
+    assert Signals(0.1, 0.1, 0, p99_ms=20.0,
+                   slo_ms=10.0).pressure() == pytest.approx(2.0)
+    # no SLO configured: the latency term is inert
+    assert Signals(0.1, 0.1, 0, p99_ms=20.0).pressure() == \
+        pytest.approx(0.1)
+
+
+def test_batcher_signals_reads_the_live_surface():
+    eng = StubEngine(max_batch=16)
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=64,
+                       max_inflight=2).start()
+    m = ServeMetrics()
+    try:
+        read = batcher_signals(b, metrics=m, slo_ms=10.0)
+        sig = read()
+        assert sig.queue_frac == 0.0 and sig.inflight_frac == 0.0
+        assert sig.shed_delta == 0 and sig.slo_ms == 10.0
+        # a rejection between ticks surfaces as shed_delta once, then
+        # the baseline advances — shed is a DELTA, not a level
+        m.record_reject(rows=4)
+        assert read().shed_delta == 1
+        assert read().shed_delta == 0
+    finally:
+        b.stop()
+
+
+# -- decision discipline ---------------------------------------------------
+
+
+def test_hysteresis_bands_gate_grow_and_shrink():
+    act = FakeActuator(floor=1, ceiling=4)
+    act.units = 2
+    box = _Box()
+    asc = _asc(act, box)
+    box.sig = Signals(0.9, 0.0, 0)               # above high: grow
+    a = asc.tick()
+    assert a["direction"] == "grow" and act.units == 3
+    box.sig = Signals(0.5, 0.0, 0)               # dead band: hold
+    assert asc.tick() is None and act.units == 3
+    box.sig = Signals(0.1, 0.0, 0)               # below low: shrink
+    a = asc.tick()
+    assert a["direction"] == "shrink" and act.units == 2
+
+
+def test_cooldown_suppresses_and_flaps_stay_zero():
+    act = FakeActuator(floor=1, ceiling=4)
+    box = _Box(queue_frac=0.9)
+    asc = _asc(act, box, cooldown_s=60.0)
+    assert asc.tick()["direction"] == "grow"
+    # an immediate reversal attempt lands INSIDE the cooldown window
+    box.sig = Signals(0.0, 0.0, 0)
+    assert asc.tick() is None
+    assert asc.suppressed == 1
+    assert asc.flaps() == 0, "cooldown exists to make this zero"
+    assert len(asc.actions) == 1
+
+
+def test_ceiling_is_disclosed_saturation_not_silent_clamping():
+    act = FakeActuator(floor=1, ceiling=2)
+    act.units = 2
+    box = _Box(queue_frac=1.0)
+    asc = _asc(act, box)
+    assert asc.tick() is None
+    assert asc.saturated_ticks == 1
+    assert act.calls == [], "a saturated tick must not actuate"
+
+
+def test_floor_holds_and_quiet_trough_does_not_underflow():
+    act = FakeActuator(floor=2, ceiling=4)
+    act.units = 2
+    box = _Box(queue_frac=0.0)
+    asc = _asc(act, box)
+    assert asc.tick() is None and act.units == 2
+    assert act.calls == []
+
+
+def test_actuator_death_is_counted_and_loop_survives():
+    act = FakeActuator(floor=1, ceiling=4, fail_next=1)
+    box = _Box(queue_frac=0.9)
+    asc = _asc(act, box)
+    assert asc.tick() is None
+    assert asc.errors == 1 and asc.actions == []
+    # next tick retries against fresh state and succeeds
+    assert asc.tick()["direction"] == "grow"
+    assert act.units == 2
+
+
+def test_actions_are_priced_on_the_cost_model():
+    act = FakeActuator(floor=1, ceiling=4, per_unit_rows=100.0)
+    box = _Box(queue_frac=0.9)
+    asc = _asc(act, box)
+    a = asc.tick()
+    assert a["price_chip_s_per_s"] == pytest.approx(1.0)
+    assert a["predicted_gain_rows_per_s"] == pytest.approx(100.0)
+    assert a["cost_basis"] == "fake-units"
+    assert a["from_units"] == 1 and a["achieved_units"] == 2
+    # an incomplete cost table prices as unknown, never a guess
+    act2 = FakeActuator(floor=1, ceiling=4, per_unit_rows=None)
+    a2 = _asc(act2, _Box(queue_frac=0.9)).tick()
+    assert a2["predicted_gain_rows_per_s"] is None
+
+
+def test_constructor_rejects_inverted_bands_and_bounds():
+    act = FakeActuator(floor=1, ceiling=4)
+    with pytest.raises(ValueError):
+        _asc(act, _Box(), high=0.3, low=0.5)
+    with pytest.raises(ValueError):
+        _asc(act, _Box(), cooldown_s=-1.0)
+    with pytest.raises(ValueError):
+        Autoscaler(act, _Box().read, floor=5, ceiling=4)
+    with pytest.raises(ValueError):
+        WindowActuator(object(), floor=3, ceiling=2)
+    with pytest.raises(ValueError):
+        GatewayActuator(object(), floor=0, ceiling=2)
+
+
+def test_started_loop_acts_and_stop_joins():
+    act = FakeActuator(floor=1, ceiling=4)
+    box = _Box(queue_frac=0.9)
+    asc = _asc(act, box, interval_s=0.01).start()
+    deadline = time.monotonic() + 10.0
+    while not asc.actions and time.monotonic() < deadline:
+        time.sleep(0.01)
+    asc.stop()
+    assert asc._thread is None
+    assert asc.actions and asc.actions[0]["direction"] == "grow"
+    n = len(asc.actions)
+    time.sleep(0.05)
+    assert len(asc.actions) == n, "loop still acting after stop()"
+    d = asc.describe()
+    assert d["actuator"] == "fake" and d["scale"] == act.units
+
+
+# -- WindowActuator against the real batcher -------------------------------
+
+
+def test_window_actuator_walks_window_and_bucket_ladder():
+    eng = StubEngine(max_batch=16)          # buckets (4, 8, 16)
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=64,
+                       max_inflight=4).start()
+    try:
+        act = WindowActuator(b, floor=1, ceiling=4, base_max_batch=4)
+        # unit u: window u, bucket u-1 rungs above the base, clamped
+        # to the warmed ladder top — NEVER a new jit key
+        assert act.plan(1) == (1, 4)
+        assert act.plan(2) == (2, 8)
+        assert act.plan(3) == (3, 16)
+        assert act.plan(4) == (4, 16)
+        assert act.scale_to(1) == 1
+        assert b.window() == 1 and b.max_batch == 4
+        assert act.scale_to(4) == 4
+        assert b.window() == 4 and b.max_batch == 16
+        # out-of-range targets clamp to [floor, ceiling]
+        assert act.scale_to(99) == 4
+        assert act.current() == 4
+        # requests still serve at every scale (park/unpark kept the
+        # semaphore balanced)
+        assert act.scale_to(2) == 2
+        fut = b.submit(np.zeros((3, 28, 28, 1), np.uint8))
+        assert fut.result(timeout=10).shape == (3, 10)
+    finally:
+        b.stop()
+
+
+def test_window_actuator_reports_partial_narrow_honestly():
+    """Narrowing must park permits the in-flight pipeline is still
+    holding — a full pipeline yields a PARTIAL narrow (returned
+    honestly; the next tick retries), never a blocked control loop."""
+    gate = threading.Event()
+    eng = StubEngine(max_batch=16, gate=gate)
+    b = DynamicBatcher(eng, max_wait_us=100, queue_depth=64,
+                       max_inflight=2).start()
+    try:
+        act = WindowActuator(b, floor=1, ceiling=2)
+        # two SEPARATE dispatches must occupy both window slots — wait
+        # for the first to be in flight before submitting the second,
+        # or the former coalesces them into one batch
+        futs = [b.submit(np.zeros((1, 28, 28, 1), np.uint8))]
+        assert eng.in_call.wait(timeout=10)
+        eng.in_call.clear()
+        futs.append(b.submit(np.zeros((1, 28, 28, 1), np.uint8)))
+        assert eng.in_call.wait(timeout=10)
+        deadline = time.monotonic() + 10.0
+        while eng.inflight < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.inflight == 2, "pipeline never filled both slots"
+        t0 = time.monotonic()
+        got = act.scale_to(1)
+        assert got == 2, f"narrow should be refused while full, got {got}"
+        assert time.monotonic() - t0 < 10.0
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        deadline = time.monotonic() + 10.0
+        while act.scale_to(1) != 1:
+            assert time.monotonic() < deadline, (
+                "narrow never completed after the pipeline drained")
+        assert b.window() == 1
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_window_actuator_prices_capacity_from_the_cost_table():
+    eng = StubEngine(max_batch=16)
+    eng.costs = eng.linear_costs()          # complete, compute-priced
+    b = DynamicBatcher(eng, max_wait_us=1000, queue_depth=64,
+                       max_inflight=2).start()
+    try:
+        act = WindowActuator(b, floor=1, ceiling=2, base_max_batch=4)
+        cap = act.capacity_rows_per_s(1)
+        assert cap is not None and cap > 0
+        assert act.chip_fraction(2) == 2.0
+        assert act.cost_basis == "inflight-window-slot-seconds"
+    finally:
+        b.stop()
+    # no cost table yet: pricing reports unknown instead of a guess
+    eng2 = StubEngine(max_batch=16)
+    b2 = DynamicBatcher(eng2, max_wait_us=1000, queue_depth=64,
+                        max_inflight=2).start()
+    try:
+        act2 = WindowActuator(b2, floor=1, ceiling=2)
+        assert act2.capacity_rows_per_s(1) is None
+    finally:
+        b2.stop()
+
+
+# -- GatewayActuator over a gateway-shaped fake ----------------------------
+
+
+class _FakeWorker:
+    def __init__(self, rid):
+        self.rid = rid
+        self.state = "active"
+
+
+class _FakeGateway:
+    def __init__(self, boot=("g1",)):
+        self.workers = {r: _FakeWorker(r) for r in boot}
+        self.joined = []
+
+    def _active(self):
+        return [w for w in self.workers.values()
+                if w.state == "active"]
+
+    def add_worker(self, worker):
+        if worker.rid in self.workers:
+            raise ValueError(f"worker {worker.rid!r} already joined")
+        self.joined.append(worker.rid)
+        self.workers[worker.rid] = worker
+
+    def drain_worker(self, rid, timeout_s=30.0):
+        w = self.workers.get(rid)
+        if w is None or w.state != "active":
+            raise ValueError(f"no active worker {rid!r} to drain")
+        if len(self._active()) <= 1:
+            raise ValueError("cannot drain the last active worker")
+        w.state = "drained"
+        del self.workers[rid]
+        return w
+
+
+def test_gateway_actuator_spawns_and_drains_lifo():
+    gw = _FakeGateway(boot=("g1",))
+    terminated = []
+    act = GatewayActuator(
+        gw, floor=1, ceiling=3,
+        spawn=_FakeWorker, terminate=terminated.append,
+        per_worker_rows_per_s=500.0)
+    assert act.current() == 1
+    assert act.scale_to(3) == 3
+    assert gw.joined == ["as1", "as2"]
+    # shrink drains the YOUNGEST autoscaled workers first; the
+    # boot-time member is untouchable while grown workers remain
+    assert act.scale_to(1) == 1
+    assert [w.rid for w in terminated] == ["as2", "as1"]
+    assert list(gw.workers) == ["g1"]
+    assert act.capacity_rows_per_s(2) == pytest.approx(1000.0)
+    assert act.cost_basis == "worker-chip-seconds"
+    # floor clamps an underflow request at the actuator too
+    assert act.scale_to(0) == 1
+
+
+def test_gateway_actuator_death_mid_grow_propagates_to_the_loop():
+    gw = _FakeGateway(boot=("g1",))
+
+    def dying_spawn(rid):
+        raise RuntimeError("spawn failed (injected)")
+
+    act = GatewayActuator(gw, floor=1, ceiling=3, spawn=dying_spawn,
+                          terminate=lambda w: None)
+    asc = _asc(act, _Box(queue_frac=0.9))
+    assert asc.tick() is None
+    assert asc.errors == 1
+    assert act.current() == 1, "failed grow must not leak members"
+
+
+# -- metrics + Prometheus export -------------------------------------------
+
+
+def test_autoscale_metrics_snapshot_and_prometheus_series():
+    m = ServeMetrics()
+    act = FakeActuator(floor=1, ceiling=2)
+    box = _Box(queue_frac=0.9)
+    asc = _asc(act, box, cooldown_s=60.0, metrics=m)
+    asc.tick()                               # grow 1 -> 2 (applied)
+    asc.tick()                               # at ceiling: saturated
+    box.sig = Signals(0.0, 0.0, 0)
+    asc.tick()                               # in cooldown: suppressed
+    s = m.snapshot()["autoscale"]
+    assert s["scale"] == 2
+    assert s["decisions"] == {"grow": 1}
+    assert s["suppressed"] == 1 and s["saturated_ticks"] == 1
+    assert s["last_cost_chip_s"] == pytest.approx(1.0)
+    text = metrics_mod.prometheus_exposition(m.snapshot())
+    for series in ("dmnist_serve_autoscale_scale 2",
+                   'dmnist_serve_autoscale_decisions_total'
+                   '{direction="grow"} 1',
+                   "dmnist_serve_autoscale_suppressed_total 1",
+                   "dmnist_serve_autoscale_saturated_total 1",
+                   "dmnist_serve_autoscale_last_cost_chip_seconds 1"):
+        assert series in text, f"missing series {series!r}"
+    # no autoscaler running: the scale gauge is ABSENT, not zero (a
+    # zero would read as "scaled to nothing" on a dashboard)
+    idle = metrics_mod.prometheus_exposition(ServeMetrics().snapshot())
+    assert "dmnist_serve_autoscale_scale " not in idle

@@ -1,0 +1,183 @@
+"""Trace-replay load generation contracts (serve/workload.py, ISSUE
+20): the spec grammar fails loudly on anything malformed (and bench.py
+maps that to exit 2 at argparse), one seed materializes to BYTE-
+identical schedules forever, legs are independent streams, every
+shape's events respect its declared envelope, and the drifting-Zipf
+shape measurably churns a bounded LRU versus the pinned-hot-set
+control — the property the PR 10 cache bench leans on."""
+
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+
+import pytest
+
+from distributedmnist_tpu.serve import workload
+from tests.conftest import worker_env
+
+pytestmark = pytest.mark.autoscale
+
+
+def _run_bench(extra, timeout=120):
+    env, repo = worker_env()
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")] + extra,
+        capture_output=True, text=True, env=env, cwd=repo,
+        timeout=timeout)
+
+
+# -- spec grammar ----------------------------------------------------------
+
+
+def test_parse_defaults_and_overrides():
+    legs = workload.parse_trace_spec(
+        "square:qps=30,burst=6,period=1.5;zipf:keys=16,hot=4")
+    assert [l.shape for l in legs] == ["square", "zipf"]
+    sq = legs[0].params
+    assert (sq["qps"], sq["burst"], sq["period"]) == (30.0, 6.0, 1.5)
+    assert sq["duty"] == 0.5                      # untouched default
+    zp = legs[1].params
+    assert (zp["keys"], zp["hot"]) == (16, 4)
+    assert workload.total_duration(legs) == pytest.approx(
+        sq["duration"] + zp["duration"])
+    # describe() round-trips into the bench artifact
+    desc = workload.describe(legs)
+    assert desc[0]["shape"] == "square"
+    assert desc[1]["params"]["hot"] == 4
+
+
+@pytest.mark.parametrize("spec,fragment", [
+    ("bogus:qps=10", "unknown trace shape"),
+    ("square:qps", "want key=value"),
+    ("square:nope=3", "unknown parameter"),
+    ("square:qps=fast", "want float"),
+    ("", "contains no legs"),
+    ("square:duty=1.5", "duty must be in (0, 1)"),
+    ("square:qps=0", "qps must be > 0"),
+    ("zipf:alpha=0.9", "alpha must be > 1"),
+    ("zipf:hot=99,keys=8", "hot must be in [1, keys]"),
+    ("spike:at=3,width=2,duration=4", "must fit inside duration"),
+    ("ragged:max_rows=0", "max_rows must be >= 1"),
+])
+def test_parse_rejects_malformed(spec, fragment):
+    with pytest.raises(ValueError) as e:
+        workload.parse_trace_spec(spec)
+    assert fragment in str(e.value)
+
+
+def test_bench_rejects_bad_trace_spec_at_argparse():
+    """A malformed --trace-replay must die at argparse (exit 2) naming
+    the offending fragment — never replay *something else*; and
+    --autoscale without a trace is meaningless (there is no load to
+    react to)."""
+    out = _run_bench(["serve", "--trace-replay", "bogus:qps=10",
+                      "--no-artifact"])
+    assert out.returncode == 2, out.stderr[-2000:]
+    assert "unknown trace shape" in out.stderr
+    out = _run_bench(["serve", "--autoscale", "--no-artifact"])
+    assert out.returncode == 2, out.stderr[-2000:]
+    assert "--trace-replay" in out.stderr
+
+
+# -- deterministic replay --------------------------------------------------
+
+
+def test_same_seed_materializes_byte_identical():
+    spec = ("diurnal:qps=40,peak=4,duration=2;"
+            "square:qps=30,burst=5,duration=2;"
+            "zipf:qps=50,duration=2,drift_every=0.5")
+    legs = workload.parse_trace_spec(spec)
+    a = workload.schedule_bytes(workload.materialize(legs, seed=7))
+    b = workload.schedule_bytes(
+        workload.materialize(workload.parse_trace_spec(spec), seed=7))
+    assert a == b, "same (spec, seed) must replay bit-identically"
+    assert len(a) > 0
+    c = workload.schedule_bytes(workload.materialize(legs, seed=8))
+    assert a != c, "a different seed must produce a different schedule"
+
+
+def test_legs_are_independent_streams():
+    """Appending a leg must not perturb an earlier leg's arrivals —
+    each leg draws from its own (seed, index)-derived stream, so a
+    trace can be extended without invalidating the prefix."""
+    one = workload.materialize(
+        workload.parse_trace_spec("square:qps=40,duration=2"), seed=3)
+    both = workload.materialize(
+        workload.parse_trace_spec(
+            "square:qps=40,duration=2;spike:qps=20,duration=2,"
+            "at=0.5,width=0.5"), seed=3)
+    prefix = [e for e in both if e.t < 2.0]
+    assert workload.schedule_bytes(prefix) == workload.schedule_bytes(one)
+
+
+# -- shape envelopes -------------------------------------------------------
+
+
+def test_events_respect_the_leg_envelope():
+    legs = workload.parse_trace_spec(
+        "square:qps=60,burst=5,duration=3,period=1,duty=0.3,"
+        "rows=4,keys=8")
+    events = workload.materialize(legs, seed=11)
+    assert events, "a 3 s leg at >= 60 qps produced nothing"
+    assert all(0.0 <= e.t < 3.0 for e in events)
+    assert all(e.t <= n.t for e, n in zip(events, events[1:])), (
+        "schedule must be sorted by arrival offset")
+    assert all(e.rows == 4 for e in events)
+    assert all(0 <= e.key < 8 for e in events)
+    # the burst phase (first 30% of each period) must be visibly denser
+    # than the off phase — 5x the rate over a fixed window
+    burst = sum(1 for e in events if (e.t % 1.0) < 0.3)
+    off = len(events) - burst
+    assert burst > off, (
+        f"burst window got {burst} arrivals vs {off} off-phase — the "
+        "square wave is not shaping the rate")
+
+
+def test_ragged_mixes_row_sizes():
+    events = workload.materialize(
+        workload.parse_trace_spec(
+            "ragged:qps=80,duration=2,max_rows=20"), seed=5)
+    sizes = {e.rows for e in events}
+    assert all(1 <= r <= 20 for r in sizes)
+    assert len(sizes) >= 8, (
+        f"ragged drew only {sorted(sizes)} — no adversarial size mix")
+
+
+# -- the drifting hot set churns a bounded cache ---------------------------
+
+
+def _lru_hit_ratio(events, capacity):
+    lru, hits = OrderedDict(), 0
+    for e in events:
+        if e.key in lru:
+            hits += 1
+            lru.move_to_end(e.key)
+        else:
+            lru[e.key] = True
+            if len(lru) > capacity:
+                lru.popitem(last=False)
+    return hits / max(len(events), 1)
+
+
+def test_zipf_drift_churns_cache_vs_static_control():
+    """The zipf shape's CONTRACT: with drift_every > 0 the hot set
+    rotates, so a bounded LRU that comfortably holds the static hot
+    set keeps missing after every rotation — the hit ratio drops
+    measurably versus the drift_every=0 control on the SAME rate, key
+    universe and skew. (This is the property that makes the shape
+    worth benching the PR 10 cache under.)"""
+    base = "zipf:qps=150,duration=4,keys=64,hot=8,alpha=2.0"
+    static = workload.materialize(
+        workload.parse_trace_spec(base + ",drift_every=0"), seed=9)
+    drift = workload.materialize(
+        workload.parse_trace_spec(base + ",drift_every=0.25"), seed=9)
+    cap = 12                       # holds the hot set + some cold tail
+    static_hits = _lru_hit_ratio(static, cap)
+    drift_hits = _lru_hit_ratio(drift, cap)
+    assert static_hits > drift_hits, (
+        f"drifting hot set did not churn: static {static_hits:.3f} "
+        f"vs drift {drift_hits:.3f}")
+    assert static_hits - drift_hits > 0.08, (
+        f"churn too small to bench against: static {static_hits:.3f} "
+        f"vs drift {drift_hits:.3f}")
